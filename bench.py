@@ -1,0 +1,50 @@
+"""Benchmark: steady-state CIFAR-10 training throughput (images/sec/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol (BASELINE.md): the reference's own measurement design — per-step
+wall-clock fenced with block_until_ready, 20-iteration windows, the first
+window (compile + warmup) excluded — on the flagship config: VGG-11,
+CIFAR-10 (synthetic stand-in when the real set is absent; identical shapes
+and dtypes), global batch 256, SGD(0.1, 0.9, 1e-4), bucketed-fused 'ddp'
+strategy over all available chips.
+
+vs_baseline: the reference publishes no numbers (BASELINE.json
+"published": {}), so the comparison point is the reference's own stack
+measured on this host — torch CPU VGG-11 fwd+bwd+step at batch 256
+(see BASELINE.md "host torch CPU baseline"; measured at 38.9 images/sec
+on this machine).
+"""
+
+import json
+import os
+import sys
+
+# Reference stack on this host (torch CPU, batch 256): images/sec.
+# Measured with tools/bench_torch_baseline.py (38.9 img/s); see BASELINE.md.
+TORCH_CPU_BASELINE_IPS = 38.9
+
+
+def main() -> None:
+    # Use whatever platform the driver provides (TPU under axon; CPU in CI).
+    import jax
+
+    from cs744_ddp_tpu.train.loop import Trainer
+
+    ndev = len(jax.devices())
+    strategy = "ddp" if ndev > 1 else "single"
+    trainer = Trainer(model="vgg11", strategy=strategy,
+                      num_devices=ndev, global_batch=256,
+                      data_dir=os.environ.get("CIFAR_DATA_DIR", "./data"),
+                      log=lambda s: print(s, file=sys.stderr))
+    ips, ips_per_chip = trainer.steady_state_throughput(max_iters=60)
+    print(json.dumps({
+        "metric": "cifar10_vgg11_images_per_sec_per_chip",
+        "value": round(ips_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips_per_chip / TORCH_CPU_BASELINE_IPS, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""cs744_ddp_tpu — a TPU-native (JAX/XLA) data-parallel training framework.
+
+Re-implements, TPU-first, the capability set of the reference
+harsh-rawat/CS744-Distributed-Data-Parallel (see SURVEY.md): synchronous
+data-parallel training of VGG/ResNet CNNs on CIFAR-10 with three
+interchangeable gradient-synchronization strategies
+
+  * ``gather``    — root-mediated gather -> mean -> broadcast
+                    (reference: src/Part 2a/main.py:117-127)
+  * ``allreduce`` — one all-reduce per parameter leaf
+                    (reference: src/Part 2b/main.py:116-119)
+  * ``ddp``       — bucketed, fused all-reduce, the DistributedDataParallel
+                    equivalent (reference: src/Part 3/main.py:61)
+
+expressed as XLA collectives over a ``jax.sharding.Mesh`` inside
+``shard_map``-compiled SPMD programs, instead of eager Gloo collectives.
+"""
+
+__version__ = "0.1.0"

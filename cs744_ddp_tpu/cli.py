@@ -1,0 +1,80 @@
+"""CLI — the reference's argparse surface plus strategy/model selection.
+
+Reference flags (``/root/reference/src/Part 2a/main.py:156-175``):
+``--master`` (coordinator IP, required there), ``--num-nodes``, ``--rank``,
+``--epochs`` (default 1); port 6585 and global batch 256 hardcoded.  Here the
+same knobs exist (with modern aliases), plus:
+
+  * ``--strategy {single,gather,allreduce,ddp}`` selects the Part-1/2a/2b/3
+    gradient-sync strategy;
+  * ``--model {vgg11,resnet18}`` selects the model (resnet18 = the
+    BASELINE.json stress config);
+  * ``--num-devices`` restricts the mesh (e.g. to compare 1 vs 8 chips).
+
+Run: ``python -m cs744_ddp_tpu.cli --strategy ddp --epochs 1``
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .ops import sgd
+from .parallel import mesh as meshlib
+from .train.loop import GLOBAL_BATCH, Trainer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("cs744_ddp_tpu")
+    p.add_argument("--master", "--coordinator", dest="master", default=None,
+                   help="coordinator address for multi-host runs "
+                        "(reference --master)")
+    p.add_argument("--num-nodes", "--num-processes", dest="num_nodes",
+                   type=int, default=1,
+                   help="number of host processes (reference --num-nodes)")
+    p.add_argument("--rank", "--process-id", dest="rank", type=int, default=0,
+                   help="this process's id (reference --rank)")
+    p.add_argument("--epochs", type=int, default=1,
+                   help="epochs to run (reference default 1)")
+    p.add_argument("--strategy", default="allreduce",
+                   choices=["single", "gather", "allreduce", "ddp"],
+                   help="gradient sync strategy: Part 1/2a/2b/3 equivalents")
+    p.add_argument("--model", default="vgg11",
+                   choices=["vgg11", "vgg13", "vgg16", "vgg19", "resnet18"])
+    p.add_argument("--batch-size", type=int, default=GLOBAL_BATCH,
+                   help="GLOBAL batch (divided across workers, as in the "
+                        "reference: Part 2a/main.py:22)")
+    p.add_argument("--num-devices", type=int, default=None,
+                   help="use only the first N local devices")
+    p.add_argument("--data-dir", default="./data")
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--no-augment", action="store_true")
+    p.add_argument("--profile-phases", action="store_true",
+                   help="additionally time a forward-only program to report "
+                        "the reference's fwd/bwd split")
+    p.add_argument("--port", type=int, default=6585,
+                   help="coordinator port (reference hardcodes 6585)")
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    meshlib.initialize_distributed(args.master, args.num_nodes, args.rank,
+                                   port=args.port)
+    trainer = Trainer(
+        model=args.model,
+        strategy=args.strategy,
+        num_devices=args.num_devices,
+        global_batch=args.batch_size,
+        data_dir=args.data_dir,
+        augment=not args.no_augment,
+        sgd_cfg=sgd.SGDConfig(lr=args.lr, momentum=args.momentum,
+                              weight_decay=args.weight_decay),
+        profile_phases=args.profile_phases,
+    )
+    trainer.run(args.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Data pipeline: CIFAR-10 loading, sharding, augmentation, prefetch."""
+
+from . import augment, cifar10, sharding          # noqa: F401
+from .cifar10 import Split, load                   # noqa: F401
+from .sharding import ShardedSampler, global_epoch_indices  # noqa: F401

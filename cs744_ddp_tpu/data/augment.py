@@ -1,0 +1,54 @@
+"""Augmentation: pad-4 random crop + horizontal flip + channel normalization.
+
+Reference train transform (``/root/reference/src/Part 1/main.py:84-89``):
+RandomCrop(32, padding=4) -> RandomHorizontalFlip -> ToTensor -> Normalize;
+test transform is ToTensor -> Normalize only (``:91-93``).
+
+TPU-first design: augmentation runs *on device, inside the jitted train step*,
+on the uint8 batch — shifting work off the (single-core) host and letting XLA
+fuse normalize into the first conv.  The same ops also run under vmap on CPU.
+A native C++ host-side pipeline (cs744_ddp_tpu.data.native) provides the
+torchvision-DataLoader-equivalent path for host-side preprocessing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .cifar10 import MEAN, STD
+
+# NOTE: the stat constants stay NumPy at module scope on purpose — creating
+# jnp arrays at import time would initialize the JAX backend before
+# jax.distributed.initialize() runs (multi-host bootstrap, parallel/mesh.py).
+# Inside jit they constant-fold identically.
+
+
+def normalize(images_u8: jax.Array) -> jax.Array:
+    """uint8 [.,32,32,3] -> float32, (x/255 - mean)/std (ToTensor+Normalize)."""
+    x = images_u8.astype(jnp.float32) / 255.0
+    return (x - MEAN) / STD
+
+
+def _crop_one(img: jax.Array, off: jax.Array) -> jax.Array:
+    """img: [40,40,3] padded; off: [2] int32 in [0,8]."""
+    return jax.lax.dynamic_slice(img, (off[0], off[1], jnp.int32(0)),
+                                 (32, 32, 3))
+
+
+def augment(key: jax.Array, images_u8: jax.Array) -> jax.Array:
+    """Random pad-4 crop + hflip + normalize. images_u8: [N,32,32,3] uint8.
+
+    Per-example randomness comes from a single fold of the step key —
+    deterministic given (seed, step), independent of device count.
+    """
+    n = images_u8.shape[0]
+    kc, kf = jax.random.split(key)
+    offs = jax.random.randint(kc, (n, 2), 0, 9, dtype=jnp.int32)
+    flips = jax.random.bernoulli(kf, 0.5, (n,))
+
+    padded = jnp.pad(images_u8, ((0, 0), (4, 4), (4, 4), (0, 0)))
+    cropped = jax.vmap(_crop_one)(padded, offs)
+    flipped = jnp.where(flips[:, None, None, None],
+                        cropped[:, :, ::-1, :], cropped)
+    return normalize(flipped)

@@ -1,0 +1,85 @@
+"""CIFAR-10 loading (host side, NumPy) with a deterministic synthetic fallback.
+
+The reference loads CIFAR-10 via ``torchvision.datasets.CIFAR10(download=True)``
+(``/root/reference/src/Part 1/main.py:94-103``).  This environment has no
+network egress, so:
+
+  * if the standard python-pickle batches (``cifar-10-batches-py``) exist under
+    ``data_dir`` they are loaded (bit-identical to torchvision's arrays, but
+    kept NHWC uint8 — the TPU-friendly layout);
+  * otherwise a *deterministic, learnable* synthetic stand-in with the same
+    shapes/dtypes/cardinalities (50k train / 10k test, 32x32x3 uint8,
+    10 classes) is generated, so every train/eval/bench path exercises the
+    real pipeline.
+
+Channel normalization stats match the reference exactly
+(mean=[125.3,123.0,113.9]/255, std=[63.0,62.1,66.7]/255 —
+``/root/reference/src/Part 1/main.py:82-83``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+MEAN = np.array([125.3, 123.0, 113.9], np.float32) / 255.0
+STD = np.array([63.0, 62.1, 66.7], np.float32) / 255.0
+
+TRAIN_SIZE = 50_000
+TEST_SIZE = 10_000
+NUM_CLASSES = 10
+
+
+class Split(NamedTuple):
+    images: np.ndarray  # [N,32,32,3] uint8
+    labels: np.ndarray  # [N] int32
+
+
+def _load_pickle_batches(batch_dir: str, names) -> Split:
+    imgs, labs = [], []
+    for name in names:
+        with open(os.path.join(batch_dir, name), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        imgs.append(np.ascontiguousarray(data, np.uint8))
+        labs.append(np.asarray(d[b"labels"], np.int32))
+    return Split(np.concatenate(imgs), np.concatenate(labs))
+
+
+def _class_templates() -> np.ndarray:
+    """Fixed low-frequency per-class templates, shared by BOTH splits (so a
+    model trained on the train split generalizes to the test split)."""
+    rng = np.random.default_rng(42)
+    small = rng.uniform(40, 215, size=(NUM_CLASSES, 4, 4, 3)).astype(np.float32)
+    return np.repeat(np.repeat(small, 8, axis=1), 8, axis=2)
+
+
+def _synthetic_split(n: int, seed: int) -> Split:
+    """Class-templated noisy images: trivially learnable, fully deterministic.
+
+    Each class c gets a fixed low-frequency template (shared across splits);
+    a sample is 0.75*template + 0.25*noise quantized to uint8 — enough signal
+    that a CNN's loss drops fast (the convergence oracle of SURVEY.md §4),
+    enough noise that it is not memorizable from one example.
+    """
+    rng = np.random.default_rng(seed)
+    templates = _class_templates()
+    labels = rng.integers(0, NUM_CLASSES, size=n).astype(np.int32)
+    noise = rng.uniform(0, 255, size=(n, 32, 32, 3)).astype(np.float32)
+    images = 0.75 * templates[labels] + 0.25 * noise
+    return Split(np.clip(images, 0, 255).astype(np.uint8), labels)
+
+
+def load(data_dir: str = "./data") -> Tuple[Split, Split, bool]:
+    """Return (train, test, is_real)."""
+    batch_dir = os.path.join(data_dir, "cifar-10-batches-py")
+    if os.path.isdir(batch_dir):
+        train = _load_pickle_batches(
+            batch_dir, [f"data_batch_{i}" for i in range(1, 6)])
+        test = _load_pickle_batches(batch_dir, ["test_batch"])
+        return train, test, True
+    return (_synthetic_split(TRAIN_SIZE, seed=0),
+            _synthetic_split(TEST_SIZE, seed=1), False)

@@ -1,0 +1,130 @@
+"""ctypes bindings for the native host-side loader (native/fastloader.cpp).
+
+The reference's host data path is native library code (torchvision C
+transforms + DataLoader worker processes, /root/reference/src/Part 1/
+main.py:96-101).  This is its equivalent here: threaded batch gather and
+augmentation in C++.  The library auto-builds on first use (g++, ~2s) and
+every entry point has a NumPy fallback, so the framework never hard-depends
+on the toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from .cifar10 import MEAN, STD
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libfastloader.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _nthreads() -> int:
+    return max(1, os.cpu_count() or 1)
+
+
+def load_library(build: bool = True) -> Optional[ctypes.CDLL]:
+    """Load (building if needed) libfastloader.so; None when unavailable."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    try:
+        if not os.path.exists(_SO_PATH) and build:
+            subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
+                           capture_output=True, timeout=120)
+        lib = ctypes.CDLL(_SO_PATH)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.fl_gather_u8.argtypes = [u8p, i64p, ctypes.c_int, u8p,
+                                     ctypes.c_int]
+        lib.fl_augment_f32.argtypes = [u8p, ctypes.c_int, i32p, u8p, f32p,
+                                       f32p, f32p, ctypes.c_int]
+        lib.fl_normalize_f32.argtypes = [u8p, ctypes.c_int, f32p, f32p, f32p,
+                                         ctypes.c_int]
+        lib.fl_version.restype = ctypes.c_int
+        assert lib.fl_version() == 1
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+def _ptr(a: np.ndarray, ct):
+    return a.ctypes.data_as(ctypes.POINTER(ct))
+
+
+_MEAN32 = np.ascontiguousarray(MEAN, np.float32)
+_STD32 = np.ascontiguousarray(STD, np.float32)
+
+
+def gather(dataset: np.ndarray, indices: np.ndarray) -> np.ndarray:
+    """out[i] = dataset[indices[i]] for a [N,32,32,3] uint8 dataset."""
+    lib = load_library()
+    if lib is None:
+        return dataset[indices]
+    dataset = np.ascontiguousarray(dataset)
+    idx = np.ascontiguousarray(indices, np.int64)
+    out = np.empty((len(idx), 32, 32, 3), np.uint8)
+    lib.fl_gather_u8(_ptr(dataset, ctypes.c_uint8), _ptr(idx, ctypes.c_int64),
+                     len(idx), _ptr(out, ctypes.c_uint8), _nthreads())
+    return out
+
+
+def augment(images: np.ndarray, offsets: np.ndarray, flips: np.ndarray
+            ) -> np.ndarray:
+    """Pad-4 crop + flip + normalize; images [N,32,32,3] u8 -> f32.
+
+    offsets: [N,2] int32 in [0,8]; flips: [N] bool/uint8.
+    """
+    n = len(images)
+    images = np.ascontiguousarray(images)
+    offsets = np.ascontiguousarray(offsets, np.int32)
+    flips = np.ascontiguousarray(flips, np.uint8)
+    lib = load_library()
+    out = np.empty((n, 32, 32, 3), np.float32)
+    if lib is None:
+        padded = np.pad(images, ((0, 0), (4, 4), (4, 4), (0, 0)))
+        for i in range(n):
+            oy, ox = offsets[i]
+            crop = padded[i, oy:oy + 32, ox:ox + 32]
+            if flips[i]:
+                crop = crop[:, ::-1]
+            out[i] = (crop.astype(np.float32) / 255.0 - MEAN) / STD
+        return out
+    lib.fl_augment_f32(_ptr(images, ctypes.c_uint8), n,
+                       _ptr(offsets, ctypes.c_int32),
+                       _ptr(flips, ctypes.c_uint8),
+                       _ptr(_MEAN32, ctypes.c_float),
+                       _ptr(_STD32, ctypes.c_float),
+                       _ptr(out, ctypes.c_float), _nthreads())
+    return out
+
+
+def normalize(images: np.ndarray) -> np.ndarray:
+    """ToTensor+Normalize (test transform) on host."""
+    images = np.ascontiguousarray(images)
+    lib = load_library()
+    if lib is None:
+        return (images.astype(np.float32) / 255.0 - MEAN) / STD
+    out = np.empty(images.shape, np.float32)
+    lib.fl_normalize_f32(_ptr(images, ctypes.c_uint8), len(images),
+                         _ptr(_MEAN32, ctypes.c_float),
+                         _ptr(_STD32, ctypes.c_float),
+                         _ptr(out, ctypes.c_float), _nthreads())
+    return out

@@ -1,0 +1,65 @@
+"""Dataset sharding — the DistributedSampler equivalent.
+
+Reference semantics (``/root/reference/src/Part 2a/main.py:38-44``):
+``DistributedSampler(training_set, num_replicas=size, rank=rank)`` with
+``shuffle=False`` on the loader; per-worker batch = global 256 / world_size
+(``:22``).  Two load-bearing quirks preserved here (SURVEY.md C6):
+
+  * ``set_epoch`` is never called, so the shard permutation is IDENTICAL every
+    epoch (seed-0 shuffle, once).  ``reshuffle_each_epoch=True`` opts out.
+  * the test set is NOT sharded — evaluation covers the full 10k set.
+
+Like torch's DistributedSampler, the index list is padded (by wrapping) to a
+multiple of world_size and dealt round-robin: rank r takes indices
+``perm[r::world]``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ShardedSampler:
+    """Per-rank epoch index streams over a dataset of ``n`` examples."""
+
+    def __init__(self, n: int, world: int, rank: int, *, seed: int = 0,
+                 shuffle: bool = True, reshuffle_each_epoch: bool = False):
+        if not (0 <= rank < world):
+            raise ValueError(f"rank {rank} out of range for world {world}")
+        self.n = n
+        self.world = world
+        self.rank = rank
+        self.seed = seed
+        self.shuffle = shuffle
+        self.reshuffle_each_epoch = reshuffle_each_epoch
+        self.num_samples = -(-n // world)  # ceil
+        self.total = self.num_samples * world
+
+    def epoch_indices(self, epoch: int = 0) -> np.ndarray:
+        """Indices this rank processes in ``epoch`` (len == num_samples)."""
+        if self.shuffle:
+            # Reference never reshuffles (no set_epoch); epoch enters the
+            # seed only when explicitly requested.
+            s = self.seed + (epoch if self.reshuffle_each_epoch else 0)
+            perm = np.random.default_rng(s).permutation(self.n)
+        else:
+            perm = np.arange(self.n)
+        if self.total > self.n:  # pad by wrapping, as torch does
+            perm = np.concatenate([perm, perm[: self.total - self.n]])
+        return perm[self.rank:: self.world]
+
+
+def global_epoch_indices(n: int, world: int, *, seed: int = 0,
+                         shuffle: bool = True, epoch: int = 0,
+                         reshuffle_each_epoch: bool = False) -> np.ndarray:
+    """[world, num_samples] index matrix — the SPMD view of the sampler.
+
+    Row r equals ``ShardedSampler(n, world, r).epoch_indices(epoch)``; a host
+    that feeds all local devices slices its rows from this.  Column b of the
+    matrix is global batch b's composition, matching the reference's
+    per-worker loaders exactly.
+    """
+    samplers = [ShardedSampler(n, world, r, seed=seed, shuffle=shuffle,
+                               reshuffle_each_epoch=reshuffle_each_epoch)
+                for r in range(world)]
+    return np.stack([s.epoch_indices(epoch) for s in samplers])

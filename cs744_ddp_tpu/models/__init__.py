@@ -1,0 +1,18 @@
+"""Model zoo: VGG-11/13/16/19 (reference parity) + ResNet-18 (stress config)."""
+
+from . import resnet, vgg
+
+
+def get_model(name: str):
+    """Return (init_fn, apply_fn) for a model name used by the CLI/bench.
+
+    ``vgg11`` matches the reference's only model
+    (``/root/reference/src/Part 1/model.py:49-50``); ``resnet18`` is the
+    BASELINE.json scaling stress config.
+    """
+    name = name.lower()
+    if name in ("vgg11", "vgg13", "vgg16", "vgg19"):
+        return vgg.make(name.upper())
+    if name in ("resnet18", "resnet-18"):
+        return resnet.make()
+    raise ValueError(f"unknown model {name!r}; expected vgg11/13/16/19 or resnet18")

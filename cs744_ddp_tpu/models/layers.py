@@ -1,0 +1,157 @@
+"""Functional NN layer primitives with PyTorch-default initialization.
+
+The reference model zoo (``/root/reference/src/Part 1/model.py``) is built from
+``nn.Conv2d(3x3, pad=1, bias=True)`` + ``nn.BatchNorm2d`` + ``nn.ReLU`` blocks
+with ``nn.MaxPool2d(2,2)`` and a final ``nn.Linear``.  This module supplies the
+same primitives as pure functions over parameter pytrees — the TPU-idiomatic
+formulation: arrays are NHWC (XLA:TPU's preferred conv layout), every apply is
+traceable/jittable, and state (BatchNorm running stats) is threaded explicitly.
+
+Initialization matches PyTorch defaults exactly so that loss curves are
+comparable to the reference:
+
+  * Conv2d / Linear weight: ``kaiming_uniform_(a=sqrt(5))`` which reduces to
+    ``U(-1/sqrt(fan_in), 1/sqrt(fan_in))``.
+  * Conv2d / Linear bias:   ``U(-1/sqrt(fan_in), 1/sqrt(fan_in))``.
+  * BatchNorm: gamma=1, beta=0, running_mean=0, running_var=1.
+
+(see torch.nn.modules.conv/linear reset_parameters; verified against torch in
+tests/test_layers.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+# BatchNorm constants matching torch.nn.BatchNorm2d defaults.
+BN_MOMENTUM = 0.1
+BN_EPS = 1e-5
+
+
+def _torch_uniform(key: jax.Array, shape: Tuple[int, ...], bound: float,
+                   dtype=jnp.float32) -> jax.Array:
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+# ---------------------------------------------------------------------------
+# Conv2d (3x3/anything, NHWC activations, HWIO weights)
+# ---------------------------------------------------------------------------
+
+def conv2d_init(key: jax.Array, in_ch: int, out_ch: int, ksize: int = 3,
+                dtype=jnp.float32, *, bias: bool = True) -> Params:
+    """PyTorch-default conv init. Weight stored HWIO (TPU-native layout).
+
+    ``bias=False`` matches ``nn.Conv2d(..., bias=False)`` — used by ResNet
+    blocks where a BatchNorm immediately follows.
+    """
+    wkey, bkey = jax.random.split(key)
+    fan_in = in_ch * ksize * ksize
+    bound = 1.0 / math.sqrt(fan_in)
+    p = {"w": _torch_uniform(wkey, (ksize, ksize, in_ch, out_ch), bound, dtype)}
+    if bias:
+        p["b"] = _torch_uniform(bkey, (out_ch,), bound, dtype)
+    return p
+
+
+def conv2d_apply(params: Params, x: jax.Array, stride: int = 1,
+                 padding: int = 1) -> jax.Array:
+    """x: [N,H,W,C] -> [N,H',W',out_ch]."""
+    y = lax.conv_general_dilated(
+        x, params["w"],
+        window_strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if "b" in params:
+        y = y + params["b"]
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Linear
+# ---------------------------------------------------------------------------
+
+def linear_init(key: jax.Array, in_features: int, out_features: int,
+                dtype=jnp.float32) -> Params:
+    wkey, bkey = jax.random.split(key)
+    bound = 1.0 / math.sqrt(in_features)
+    # Stored [in, out] so apply is x @ w (no transpose on the MXU).
+    return {
+        "w": _torch_uniform(wkey, (in_features, out_features), bound, dtype),
+        "b": _torch_uniform(bkey, (out_features,), bound, dtype),
+    }
+
+
+def linear_apply(params: Params, x: jax.Array) -> jax.Array:
+    return x @ params["w"] + params["b"]
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm2d (torch semantics)
+# ---------------------------------------------------------------------------
+
+def batchnorm_init(num_features: int, dtype=jnp.float32) -> Tuple[Params, State]:
+    params = {
+        "gamma": jnp.ones((num_features,), dtype),
+        "beta": jnp.zeros((num_features,), dtype),
+    }
+    state = {
+        "mean": jnp.zeros((num_features,), dtype),
+        "var": jnp.ones((num_features,), dtype),
+    }
+    return params, state
+
+
+def batchnorm_apply(params: Params, state: State, x: jax.Array, *,
+                    train: bool) -> Tuple[jax.Array, State]:
+    """Torch-parity BatchNorm over NHWC.
+
+    Training normalizes with the *biased* batch variance and updates running
+    stats with the *unbiased* variance (torch.nn.BatchNorm2d semantics,
+    momentum=0.1).  In the data-parallel setting the batch stats are the
+    *local shard's* stats — matching the reference, where each replica's BN
+    sees only its own shard (SURVEY.md §7 "BatchNorm semantics in DP").
+    """
+    if train:
+        axes = (0, 1, 2)
+        mean = jnp.mean(x, axes)
+        var = jnp.mean(jnp.square(x - mean), axes)  # biased
+        n = x.shape[0] * x.shape[1] * x.shape[2]
+        unbiased = var * (n / max(n - 1, 1))
+        new_state = {
+            "mean": (1 - BN_MOMENTUM) * state["mean"] + BN_MOMENTUM * mean,
+            "var": (1 - BN_MOMENTUM) * state["var"] + BN_MOMENTUM * unbiased,
+        }
+        use_mean, use_var = mean, var
+    else:
+        new_state = state
+        use_mean, use_var = state["mean"], state["var"]
+
+    inv = lax.rsqrt(use_var + BN_EPS)
+    y = (x - use_mean) * inv * params["gamma"] + params["beta"]
+    return y, new_state
+
+
+# ---------------------------------------------------------------------------
+# MaxPool 2x2/2 (reference model.py:16: MaxPool2d(kernel_size=2, stride=2))
+# ---------------------------------------------------------------------------
+
+def maxpool2x2(x: jax.Array) -> jax.Array:
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, 2, 2, 1),
+        window_strides=(1, 2, 2, 1),
+        padding="VALID",
+    )
+
+
+def relu(x: jax.Array) -> jax.Array:
+    return jnp.maximum(x, 0)

@@ -1,0 +1,109 @@
+"""ResNet-18 (CIFAR-10 variant) — the scaling stress config.
+
+BASELINE.json config #5 calls for "ResNet-18 / CIFAR-10 8-worker allreduce
+(scaling stress beyond coursework)".  This is the standard CIFAR-adapted
+ResNet-18: a 3x3 stem (no 7x7/maxpool — inputs are 32x32), four stages of two
+BasicBlocks at widths (64,128,256,512) with strides (1,2,2,2), global average
+pool, Linear(512,10).  Same functional (init, apply) contract as models.vgg.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+
+STAGES = ((64, 1), (128, 2), (256, 2), (512, 2))
+BLOCKS_PER_STAGE = 2
+NUM_CLASSES = 10
+
+
+def _block_init(key, in_ch, out_ch, stride, dtype):
+    k1, k2, k3 = jax.random.split(key, 3)
+    p: Dict[str, Any] = {}
+    s: Dict[str, Any] = {}
+    p["conv1"] = layers.conv2d_init(k1, in_ch, out_ch, 3, dtype, bias=False)
+    p["bn1"], s["bn1"] = layers.batchnorm_init(out_ch, dtype)
+    p["conv2"] = layers.conv2d_init(k2, out_ch, out_ch, 3, dtype, bias=False)
+    p["bn2"], s["bn2"] = layers.batchnorm_init(out_ch, dtype)
+    if stride != 1 or in_ch != out_ch:
+        p["down_conv"] = layers.conv2d_init(k3, in_ch, out_ch, 1, dtype, bias=False)
+        p["down_bn"], s["down_bn"] = layers.batchnorm_init(out_ch, dtype)
+    return p, s
+
+
+def _block_apply(p, s, x, stride, *, train):
+    ns: Dict[str, Any] = {}
+    y = layers.conv2d_apply(p["conv1"], x, stride=stride, padding=1)
+    y, ns["bn1"] = layers.batchnorm_apply(p["bn1"], s["bn1"], y, train=train)
+    y = layers.relu(y)
+    y = layers.conv2d_apply(p["conv2"], y, stride=1, padding=1)
+    y, ns["bn2"] = layers.batchnorm_apply(p["bn2"], s["bn2"], y, train=train)
+    if "down_conv" in p:
+        sc = layers.conv2d_apply(p["down_conv"], x, stride=stride, padding=0)
+        sc, ns["down_bn"] = layers.batchnorm_apply(p["down_bn"], s["down_bn"],
+                                                   sc, train=train)
+    else:
+        sc = x
+    return layers.relu(y + sc), ns
+
+
+def init(key: jax.Array, dtype=jnp.float32) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    key, sub = jax.random.split(key)
+    params: Dict[str, Any] = {
+        "stem_conv": layers.conv2d_init(sub, 3, 64, 3, dtype, bias=False)}
+    state: Dict[str, Any] = {}
+    params["stem_bn"], state["stem_bn"] = layers.batchnorm_init(64, dtype)
+
+    in_ch = 64
+    blocks_p, blocks_s = [], []
+    for width, stage_stride in STAGES:
+        for b in range(BLOCKS_PER_STAGE):
+            stride = stage_stride if b == 0 else 1
+            key, sub = jax.random.split(key)
+            bp, bs = _block_init(sub, in_ch, width, stride, dtype)
+            blocks_p.append(bp)
+            blocks_s.append(bs)
+            in_ch = width
+    params["blocks"] = blocks_p
+    state["blocks"] = blocks_s
+
+    key, sub = jax.random.split(key)
+    params["fc"] = layers.linear_init(sub, 512, NUM_CLASSES, dtype)
+    return params, state
+
+
+def apply(params, state, x: jax.Array, *,
+          train: bool) -> Tuple[jax.Array, Dict[str, Any]]:
+    """x: [N,32,32,3] -> logits [N,10], new state."""
+    new_state: Dict[str, Any] = {}
+    y = layers.conv2d_apply(params["stem_conv"], x, stride=1, padding=1)
+    y, new_state["stem_bn"] = layers.batchnorm_apply(
+        params["stem_bn"], state["stem_bn"], y, train=train)
+    y = layers.relu(y)
+
+    new_blocks = []
+    i = 0
+    for width, stage_stride in STAGES:
+        for b in range(BLOCKS_PER_STAGE):
+            stride = stage_stride if b == 0 else 1
+            y, ns = _block_apply(params["blocks"][i], state["blocks"][i], y,
+                                 stride, train=train)
+            new_blocks.append(ns)
+            i += 1
+    new_state["blocks"] = new_blocks
+
+    y = jnp.mean(y, axis=(1, 2))  # global average pool -> [N,512]
+    logits = layers.linear_apply(params["fc"], y)
+    return logits, new_state
+
+
+def make():
+    return init, lambda p, s, x, *, train: apply(p, s, x, train=train)
+
+
+def ResNet18():
+    return make()

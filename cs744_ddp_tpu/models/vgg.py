@@ -1,0 +1,106 @@
+"""Config-table-driven VGG family for 32x32x3 inputs, 10 classes.
+
+Capability parity with the reference model zoo
+(``/root/reference/src/Part 1/model.py:3-50``): VGG-11/13/16/19 built from
+3x3 conv (pad 1, bias) + BatchNorm + ReLU blocks with 2x2/2 max-pool at 'M'
+markers, then a flatten-512 -> Linear(512, 10) head.  Here the model is a pure
+function pair (init/apply) over parameter & state pytrees — jit/grad/shard_map
+compose over it directly, and activations are NHWC for XLA:TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+
+CFG = {
+    "VGG11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "VGG13": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+              512, 512, "M"],
+    "VGG16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+              "M", 512, 512, 512, "M"],
+    "VGG19": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+NUM_CLASSES = 10
+
+
+def init(key: jax.Array, name: str = "VGG11",
+         dtype=jnp.float32) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Build (params, state) pytrees for the named VGG config."""
+    cfg = CFG[name]
+    conv_params = []
+    bn_params = []
+    bn_state = []
+    in_ch = 3
+    for layer_cfg in cfg:
+        if layer_cfg == "M":
+            continue
+        key, sub = jax.random.split(key)
+        conv_params.append(layers.conv2d_init(sub, in_ch, layer_cfg, 3, dtype))
+        bp, bs = layers.batchnorm_init(layer_cfg, dtype)
+        bn_params.append(bp)
+        bn_state.append(bs)
+        in_ch = layer_cfg
+    key, sub = jax.random.split(key)
+    params = {
+        "conv": conv_params,
+        "bn": bn_params,
+        "fc1": layers.linear_init(sub, 512, NUM_CLASSES, dtype),
+    }
+    state = {"bn": bn_state}
+    return params, state
+
+
+def apply(params: Dict[str, Any], state: Dict[str, Any], x: jax.Array, *,
+          train: bool, name: str = "VGG11") -> Tuple[jax.Array, Dict[str, Any]]:
+    """x: [N,32,32,3] NHWC -> logits [N,10], new state."""
+    cfg = CFG[name]
+    new_bn_state = []
+    i = 0
+    for layer_cfg in cfg:
+        if layer_cfg == "M":
+            x = layers.maxpool2x2(x)
+        else:
+            x = layers.conv2d_apply(params["conv"][i], x)
+            x, ns = layers.batchnorm_apply(params["bn"][i], state["bn"][i], x,
+                                           train=train)
+            new_bn_state.append(ns)
+            x = layers.relu(x)
+            i += 1
+    # After 5 pools: [N,1,1,512] -> flatten 512 (reference model.py:43-45).
+    x = x.reshape(x.shape[0], -1)
+    logits = layers.linear_apply(params["fc1"], x)
+    return logits, {"bn": new_bn_state}
+
+
+def make(name: str = "VGG11"):
+    """Return (init_fn, apply_fn) closed over the config name."""
+    def init_fn(key, dtype=jnp.float32):
+        return init(key, name, dtype)
+
+    def apply_fn(params, state, x, *, train):
+        return apply(params, state, x, train=train, name=name)
+
+    return init_fn, apply_fn
+
+
+def VGG11():
+    return make("VGG11")
+
+
+def VGG13():
+    return make("VGG13")
+
+
+def VGG16():
+    return make("VGG16")
+
+
+def VGG19():
+    return make("VGG19")

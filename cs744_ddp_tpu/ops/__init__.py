@@ -1,0 +1,5 @@
+"""Numerical ops: loss, optimizer, and (optional) Pallas kernels."""
+
+from .loss import accuracy_counts, cross_entropy  # noqa: F401
+from .sgd import SGDConfig, SGDState              # noqa: F401
+from . import sgd                                  # noqa: F401

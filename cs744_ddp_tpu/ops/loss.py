@@ -1,0 +1,26 @@
+"""Cross-entropy loss with torch.nn.CrossEntropyLoss semantics.
+
+The reference uses ``torch.nn.CrossEntropyLoss()`` (mean reduction) as the
+training and evaluation criterion (``/root/reference/src/Part 1/main.py:110``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean over batch of -log softmax(logits)[label].
+
+    logits: [N, C] float; labels: [N] int.  Computed via log-sum-exp for
+    stability (identical math to torch's CrossEntropyLoss mean reduction).
+    """
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - picked)
+
+
+def accuracy_counts(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Number of correct argmax predictions (reference main.py:69-71)."""
+    return jnp.sum(jnp.argmax(logits, axis=-1) == labels)

@@ -1,0 +1,49 @@
+"""SGD with PyTorch semantics: L2 weight decay folded into the gradient,
+then classic (non-Nesterov) momentum.
+
+The reference trains with ``optim.SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)``
+(``/root/reference/src/Part 1/main.py:114-115``).  PyTorch's update is:
+
+    g = grad + wd * p
+    v = mu * v + g          (v initialized to g on the first step)
+    p = p - lr * v
+
+Since the velocity buffer starts at zero, ``mu*0 + g == g`` and a single
+formula covers the first step too.  This differs from optax's
+decoupled/trace variants, so it is implemented exactly, as a pure
+jit-friendly pytree transform (SURVEY.md §7 "PyTorch SGD parity").
+Verified against torch.optim.SGD in tests/test_sgd.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SGDState(NamedTuple):
+    momentum: Any          # pytree like params; velocity buffers
+    step: jax.Array        # scalar int32 step counter
+
+
+class SGDConfig(NamedTuple):
+    lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+
+
+def init(params: Any) -> SGDState:
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    return SGDState(momentum=zeros, step=jnp.zeros((), jnp.int32))
+
+
+def update(params: Any, grads: Any, state: SGDState,
+           cfg: SGDConfig = SGDConfig()) -> tuple[Any, SGDState]:
+    """One SGD step; returns (new_params, new_state). Pure and jittable."""
+    d = jax.tree.map(lambda p, g: g + cfg.weight_decay * p, params, grads)
+    new_vel = jax.tree.map(lambda v, dd: cfg.momentum * v + dd,
+                           state.momentum, d)
+    new_params = jax.tree.map(lambda p, v: p - cfg.lr * v, params, new_vel)
+    return new_params, SGDState(momentum=new_vel, step=state.step + 1)

@@ -1,0 +1,5 @@
+"""Parallelism: mesh runtime, gradient-sync strategies, bucketing."""
+
+from . import bucketing, mesh, strategies                      # noqa: F401
+from .mesh import DATA_AXIS, batch_sharding, make_mesh         # noqa: F401
+from .strategies import STRATEGIES, get_strategy               # noqa: F401

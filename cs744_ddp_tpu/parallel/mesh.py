@@ -1,0 +1,70 @@
+"""Runtime: multi-host bootstrap + device-mesh construction.
+
+Replaces the reference's process-group bootstrap
+(``init_process`` — ``/root/reference/src/Part 2a/main.py:148-153``: export
+MASTER_ADDR/MASTER_PORT, ``dist.init_process_group('gloo', rank, world)``)
+with the TPU-native equivalents:
+
+  * ``jax.distributed.initialize(coordinator_address, num_processes,
+    process_id)`` — DCN rendezvous; on TPU pods topology is auto-discovered.
+  * a 1-D ``jax.sharding.Mesh`` over all chips, axis name ``"data"`` — the
+    data-parallel axis every collective rides (ICI within a slice).
+
+Unlike the reference (one OS process per worker, eager Gloo calls), the unit
+of parallelism is the *device*: one process drives all its local chips and the
+strategies are collectives inside one compiled SPMD program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def initialize_distributed(coordinator: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None,
+                           port: int = 6585) -> None:
+    """Multi-host rendezvous (MASTER_ADDR:6585 ≙ coordinator:port).
+
+    No-op when single-process (the reference's Part 1 case).  The hardcoded
+    default port 6585 mirrors ``Part 2a/main.py:172``.
+    """
+    if (num_processes or 1) <= 1:
+        return
+    if coordinator is None:
+        # The reference makes --master required (Part 2a/main.py:158-159);
+        # silently training N independent copies would be wrong.
+        raise ValueError("multi-process run (num_processes "
+                         f"= {num_processes}) requires a coordinator address")
+    addr = coordinator if ":" in coordinator else f"{coordinator}:{port}"
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def make_mesh(num_devices: Optional[int] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D data-parallel mesh over ``num_devices`` (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            if num_devices > len(devices):
+                raise ValueError(
+                    f"requested {num_devices} devices, have {len(devices)}")
+            devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [global_batch, ...] arrays: split dim 0 over the mesh."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,103 @@
+"""The three gradient-synchronization strategies, as collective patterns.
+
+Each strategy is a pure function ``(grads_pytree, axis_name) -> grads_pytree``
+running *inside* a ``shard_map``-compiled SPMD program; the strategy
+difference is the collective pattern XLA emits, mirroring the reference's
+spectrum (SURVEY.md §2.3):
+
+  * ``gather_scatter``  — reference Part 2a (``main.py:117-127``):
+    per parameter, rank 0 gathers every worker's grad, means them, scatters
+    the average back.  Here: per leaf, ``all_gather`` (a superset of
+    gather-to-root on ICI), the mean is computed only on mesh position 0 and
+    broadcast via a masked ``psum`` — two sequential collectives per leaf
+    with root-located compute, preserving the deliberately-naive
+    communication shape for honest benchmarking.
+
+  * ``per_param_psum``  — reference Part 2b (``main.py:116-119``):
+    one all-reduce per parameter leaf, then divide by world size.  Here: one
+    ``lax.psum`` per leaf (34 collectives for VGG-11+BN), no fusion.
+
+  * ``bucketed_psum``   — reference Part 3 (``DDP(model)``, ``main.py:61``):
+    DDP's bucketed fused reducer.  Here: leaves are flattened into ≤25 MB
+    buckets (reverse registration order, like DDP) and each bucket is one
+    fused ``psum``; XLA schedules the collectives asynchronously, giving the
+    comm/compute overlap DDP gets from backward hooks.
+
+  * ``local``           — reference Part 1: single process, no sync.
+
+XLA note: psums of separate leaves may themselves be combined by the
+compiler's all-reduce combiner; the strategies stay *observably* distinct
+because gather_scatter forces two dependent collectives per leaf and
+bucketed_psum pre-fuses into whole buckets (see tests/test_strategies.py for
+the HLO-level assertions).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .bucketing import BucketPlan, DEFAULT_BUCKET_BYTES, flatten_to_buckets, \
+    make_plan, unflatten_from_buckets
+
+Strategy = Callable[[Any, str], Any]
+
+
+def local(grads: Any, axis_name: str) -> Any:
+    """No synchronization (single-worker Part-1 semantics)."""
+    del axis_name
+    return grads
+
+
+def per_param_psum(grads: Any, axis_name: str) -> Any:
+    """One all-reduce per leaf; sum then divide by world (Part 2b parity)."""
+    world = lax.axis_size(axis_name)
+    return jax.tree.map(lambda g: lax.psum(g, axis_name) / world, grads)
+
+
+def gather_scatter(grads: Any, axis_name: str) -> Any:
+    """Root-mediated gather -> mean-on-root -> broadcast (Part 2a parity)."""
+    idx = lax.axis_index(axis_name)
+
+    def leaf(g):
+        gathered = lax.all_gather(g, axis_name)          # collective 1 (gather)
+        mean = jnp.mean(gathered, axis=0)                # compute on every
+        root_only = jnp.where(idx == 0, mean, jnp.zeros_like(mean))
+        return lax.psum(root_only, axis_name)            # collective 2 (scatter/bcast)
+
+    return jax.tree.map(leaf, grads)
+
+
+def bucketed_psum(grads: Any, axis_name: str, *,
+                  plan: Optional[BucketPlan] = None,
+                  bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> Any:
+    """Bucketed fused all-reduce — the DDP-equivalent performance tier."""
+    if plan is None:
+        plan = make_plan(grads, bucket_bytes)
+    world = lax.axis_size(axis_name)
+    buckets = flatten_to_buckets(grads, plan)
+    reduced = [lax.psum(b, axis_name) / world for b in buckets]
+    return unflatten_from_buckets(reduced, plan)
+
+
+STRATEGIES = {
+    "single": local,
+    "gather": gather_scatter,
+    "allreduce": per_param_psum,
+    "ddp": bucketed_psum,
+}
+
+
+def get_strategy(name: str, bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> Strategy:
+    """Resolve a CLI strategy name to a (grads, axis) -> grads function."""
+    name = name.lower()
+    if name not in STRATEGIES:
+        raise ValueError(
+            f"unknown strategy {name!r}; expected one of {sorted(STRATEGIES)}")
+    if name == "ddp":
+        return partial(bucketed_psum, bucket_bytes=bucket_bytes)
+    return STRATEGIES[name]

@@ -1,0 +1,6 @@
+"""Training: compiled SPMD steps + reference-parity epoch driver."""
+
+from . import loop, step                                   # noqa: F401
+from .loop import Trainer                                  # noqa: F401
+from .step import TrainState, init_train_state, make_eval_step, \
+    make_train_step                                        # noqa: F401

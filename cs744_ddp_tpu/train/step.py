@@ -1,0 +1,151 @@
+"""Compiled SPMD train / eval steps.
+
+The reference's per-batch loop body (zero_grad -> forward -> CE loss ->
+backward -> [grad sync] -> SGD step; ``/root/reference/src/Part 2a/main.py:
+86-96``) becomes ONE jitted ``shard_map`` program over the data-parallel mesh:
+the batch arrives sharded on the "data" axis, the gradient-sync strategy is a
+collective pattern between ``jax.grad`` and the optimizer update, and
+parameters/optimizer state stay replicated.  Augmentation (pad-crop/flip) and
+normalization run on device inside the same program, so the host only moves
+uint8 bytes.
+
+BatchNorm: training normalizes with the *local shard's* batch statistics —
+exactly the reference's per-replica BN semantics (SURVEY.md §7).  Running
+stats are pmean'd across shards before being stored so the replicated state
+invariant holds; this only affects evaluation and is documented in
+BASELINE.md.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..data import augment as aug
+from ..ops import sgd
+from ..ops.loss import cross_entropy
+from .. import parallel
+from ..parallel.mesh import DATA_AXIS
+
+
+class TrainState(NamedTuple):
+    params: Any
+    bn_state: Any
+    opt_state: sgd.SGDState
+
+
+def init_train_state(init_fn, key: jax.Array) -> TrainState:
+    """Seed-identical init on every process — the reference relies on
+    identical seeds instead of a parameter broadcast (SURVEY.md C12); in SPMD
+    the replicated init is constructed once and placed on all devices, making
+    that invariant structural rather than probabilistic."""
+    params, bn_state = init_fn(key)
+    return TrainState(params=params, bn_state=bn_state,
+                      opt_state=sgd.init(params))
+
+
+def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
+                    mesh: Mesh, cfg: sgd.SGDConfig = sgd.SGDConfig(),
+                    *, augment: bool = True) -> Callable:
+    """Build the jitted train step.
+
+    step(state, key, images_u8[B,32,32,3], labels[B]) -> (state, loss)
+    with B = global batch, sharded over the mesh's "data" axis.
+
+    The ``local`` strategy (reference Part 1: single process, no process
+    group — ``/root/reference/src/Part 1/main.py``) compiles WITHOUT
+    shard_map or any axis: a plain jitted step, the degenerate world-size-1
+    case, exactly as Part 1 carries no torch.distributed code.
+    """
+    if strategy is parallel.strategies.local:
+        if mesh.devices.size != 1:
+            raise ValueError("'single' strategy requires a 1-device mesh "
+                             "(reference Part 1 is world_size==1)")
+
+        @jax.jit
+        def single_step(state: TrainState, key, images, labels):
+            x = aug.augment(key, images) if augment else aug.normalize(images)
+
+            def loss_fn(p):
+                logits, new_bn = apply_fn(p, state.bn_state, x, train=True)
+                return cross_entropy(logits, labels), new_bn
+
+            (loss, new_bn), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
+            new_params, new_opt = sgd.update(state.params, grads,
+                                             state.opt_state, cfg)
+            return TrainState(new_params, new_bn, new_opt), loss
+
+        return single_step
+
+    def shard_body(params, bn_state, opt_state, key, images, labels):
+        # Distinct augmentation stream per shard, deterministic in (key, pos).
+        key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+        x = aug.augment(key, images) if augment else aug.normalize(images)
+
+        def loss_fn(p):
+            logits, new_bn = apply_fn(p, bn_state, x, train=True)
+            return cross_entropy(logits, labels), new_bn
+
+        (loss, new_bn), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = strategy(grads, DATA_AXIS)
+        new_params, new_opt = sgd.update(params, grads, opt_state, cfg)
+        new_bn = jax.tree.map(lambda a: lax.pmean(a, DATA_AXIS), new_bn)
+        loss = lax.pmean(loss, DATA_AXIS)
+        return new_params, new_bn, new_opt, loss
+
+    mapped = shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P(), P()),
+    )
+
+    @jax.jit
+    def step(state: TrainState, key, images, labels):
+        new_params, new_bn, new_opt, loss = mapped(
+            state.params, state.bn_state, state.opt_state, key, images, labels)
+        return TrainState(new_params, new_bn, new_opt), loss
+
+    return step
+
+
+def make_eval_step(apply_fn: Callable, mesh: Mesh) -> Callable:
+    """Jitted eval step over a sharded batch.
+
+    Returns (loss_sum, correct) summed over the GLOBAL batch via psum —
+    reporting the same quantities as the reference's ``test_model``
+    (``/root/reference/src/Part 1/main.py:61-76``) but computed once across
+    the mesh instead of redundantly per rank.
+    """
+
+    def shard_body(params, bn_state, images, labels):
+        x = aug.normalize(images)
+        logits, _ = apply_fn(params, bn_state, x, train=False)
+        # Reference accumulates per-batch mean CE; we return the per-example
+        # sum so partial final batches stay exact, and divide on the host.
+        # Padded examples are marked label = -1 and masked out (the final
+        # test batch of 10000 % 256 = 16 examples stays exact this way).
+        valid = labels >= 0
+        safe = jnp.maximum(labels, 0)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        loss_sum = jnp.sum(jnp.where(valid, logz - picked, 0.0))
+        correct = jnp.sum(valid & (jnp.argmax(logits, axis=-1) == safe))
+        return (lax.psum(loss_sum, DATA_AXIS),
+                lax.psum(correct, DATA_AXIS))
+
+    mapped = shard_map(shard_body, mesh=mesh,
+                       in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+                       out_specs=(P(), P()))
+
+    @jax.jit
+    def step(state: TrainState, images, labels):
+        return mapped(state.params, state.bn_state, images, labels)
+
+    return step

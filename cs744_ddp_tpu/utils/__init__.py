@@ -1,0 +1,3 @@
+"""Utilities: timing/metrics instrumentation."""
+
+from .metrics import Stopwatch, WindowedTimers             # noqa: F401

@@ -1,0 +1,83 @@
+"""Timing/metrics instrumentation with reference-parity reporting.
+
+The reference brackets forward and backward+sync+step with ``time.time()``,
+averages over 20-iteration windows, skips the FIRST window from the timing
+report (compilation/warmup), and prints running loss every 20 iterations
+(``/root/reference/src/Part 1/main.py:28-57``).  This module reproduces that
+schedule exactly — the caller is responsible for fencing with
+``jax.block_until_ready`` so the timers measure real device work rather than
+async dispatch (SURVEY.md §5 "Tracing / profiling").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+WINDOW = 20  # reference: report every 20 iterations, skip the first window
+
+
+class WindowedTimers:
+    """Per-phase accumulators over 20-iteration windows, warmup excluded."""
+
+    def __init__(self, log: Callable[[str], None] = print):
+        self.log = log
+        self.iter_number = 1
+        self.epoch_loss = 0.0
+        self.forward_time = 0.0
+        self.backward_time = 0.0
+        self.total_time = 0.0
+        # Steady-state samples (first window excluded) for throughput calc.
+        self.steady_step_times: List[float] = []
+        self.steady_forward_times: List[float] = []
+
+    def record(self, loss: float, step_time: float,
+               forward_time: Optional[float] = None) -> None:
+        """Record one iteration. ``forward_time`` is optional because the
+        functional step is a single fused program; when the trainer runs the
+        split-phase timing mode it supplies both phases (the reference's
+        'backward' bucket likewise absorbs sync+step, Part 2a/main.py:92-97).
+        """
+        self.epoch_loss += loss
+        self.total_time += step_time
+        warmup = self.iter_number <= WINDOW
+        if forward_time is not None:
+            self.forward_time += forward_time
+            self.backward_time += step_time - forward_time
+            if not warmup:
+                self.steady_forward_times.append(forward_time)
+        if not warmup:
+            self.steady_step_times.append(step_time)
+
+        if self.iter_number % WINDOW == 0:
+            self.log(f"Training loss after {self.iter_number} iterations is "
+                     f"{self.epoch_loss / WINDOW}")
+            self.epoch_loss = 0.0
+            if self.iter_number != WINDOW:  # reference warmup skip (main.py:51)
+                if forward_time is not None:
+                    self.log(f"Forward Pass time in iter {self.iter_number} "
+                             f"is {self.forward_time / WINDOW}")
+                    self.log(f"Backward Pass time in iter {self.iter_number} "
+                             f"is {self.backward_time / WINDOW}")
+                self.log(f"Average Pass time in iter {self.iter_number} is "
+                         f"{self.total_time / WINDOW}")
+            self.forward_time = 0.0
+            self.backward_time = 0.0
+            self.total_time = 0.0
+        self.iter_number += 1
+
+    def steady_images_per_sec(self, global_batch: int) -> Optional[float]:
+        if not self.steady_step_times:
+            return None
+        return global_batch * len(self.steady_step_times) / sum(
+            self.steady_step_times)
+
+
+class Stopwatch:
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.time() - self.t0
+        return False

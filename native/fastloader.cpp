@@ -1,0 +1,122 @@
+// fastloader — native host-side data pipeline kernels.
+//
+// The reference delegates its host data path to native library code:
+// torchvision's C transforms plus torch DataLoader worker processes
+// (reference: /root/reference/src/Part 1/main.py:82-109, num_workers=2).
+// This library supplies the TPU build's equivalent: multithreaded batch
+// gather and augmentation (pad-4 random crop + horizontal flip + channel
+// normalization) over NHWC uint8 CIFAR images, exposed as a C API consumed
+// via ctypes (cs744_ddp_tpu/data/native.py).
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC, no external deps)
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kH = 32, kW = 32, kC = 3, kPad = 4;
+constexpr int kImg = kH * kW * kC;
+
+inline void worker_range(int n, int nthreads, int t, int* lo, int* hi) {
+  int chunk = (n + nthreads - 1) / nthreads;
+  *lo = t * chunk;
+  *hi = std::min(n, *lo + chunk);
+}
+
+template <typename F>
+void parallel_for_images(int n, int nthreads, F&& fn) {
+  nthreads = std::max(1, std::min(nthreads, n));
+  if (nthreads == 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t) {
+    int lo, hi;
+    worker_range(n, nthreads, t, &lo, &hi);
+    if (lo >= hi) break;
+    threads.emplace_back([&fn, lo, hi] { fn(lo, hi); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather rows of a [num_images, 32*32*3] uint8 dataset into a batch:
+// out[i] = dataset[indices[i]].  The numpy equivalent (fancy indexing)
+// is single-threaded; this spreads the memcpy over threads.
+void fl_gather_u8(const uint8_t* dataset, const int64_t* indices, int n,
+                  uint8_t* out, int nthreads) {
+  parallel_for_images(n, nthreads, [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      std::memcpy(out + (size_t)i * kImg,
+                  dataset + (size_t)indices[i] * kImg, kImg);
+    }
+  });
+}
+
+// Pad-4 random crop + optional horizontal flip + normalize to float32.
+// images: [n,32,32,3] uint8; offsets: [n,2] int32 in [0,8]; flips: [n] u8;
+// mean/std: [3] float32 applied after x/255.  out: [n,32,32,3] float32.
+// Zero padding semantics match torchvision's RandomCrop(32, padding=4)
+// (reference main.py:85).
+void fl_augment_f32(const uint8_t* images, int n, const int32_t* offsets,
+                    const uint8_t* flips, const float* mean, const float* std_,
+                    float* out, int nthreads) {
+  float scale[kC], bias[kC];
+  for (int c = 0; c < kC; ++c) {
+    scale[c] = 1.0f / (255.0f * std_[c]);
+    bias[c] = -mean[c] / std_[c];
+  }
+  parallel_for_images(n, nthreads, [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      const uint8_t* img = images + (size_t)i * kImg;
+      float* dst = out + (size_t)i * kImg;
+      const int oy = offsets[2 * i], ox = offsets[2 * i + 1];
+      const bool flip = flips[i] != 0;
+      for (int y = 0; y < kH; ++y) {
+        const int sy = y + oy - kPad;  // source row in the unpadded image
+        for (int x = 0; x < kW; ++x) {
+          const int xx = flip ? (kW - 1 - x) : x;
+          const int sx = xx + ox - kPad;
+          float* px = dst + ((size_t)y * kW + x) * kC;
+          if (sy < 0 || sy >= kH || sx < 0 || sx >= kW) {
+            for (int c = 0; c < kC; ++c) px[c] = bias[c];  // zero-pixel
+          } else {
+            const uint8_t* sp = img + ((size_t)sy * kW + sx) * kC;
+            for (int c = 0; c < kC; ++c)
+              px[c] = (float)sp[c] * scale[c] + bias[c];
+          }
+        }
+      }
+    }
+  });
+}
+
+// Normalize only (the test transform: ToTensor + Normalize, main.py:91-93).
+void fl_normalize_f32(const uint8_t* images, int n, const float* mean,
+                      const float* std_, float* out, int nthreads) {
+  float scale[kC], bias[kC];
+  for (int c = 0; c < kC; ++c) {
+    scale[c] = 1.0f / (255.0f * std_[c]);
+    bias[c] = -mean[c] / std_[c];
+  }
+  parallel_for_images(n, nthreads, [&](int lo, int hi) {
+    const size_t lo_px = (size_t)lo * kH * kW, hi_px = (size_t)hi * kH * kW;
+    for (size_t p = lo_px; p < hi_px; ++p) {
+      for (int c = 0; c < kC; ++c)
+        out[p * kC + c] = (float)images[p * kC + c] * scale[c] + bias[c];
+    }
+  });
+}
+
+int fl_version() { return 1; }
+
+}  // extern "C"

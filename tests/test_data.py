@@ -1,0 +1,106 @@
+"""Data pipeline tests: sampler sharding semantics, augmentation, loading."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cs744_ddp_tpu.data import augment, cifar10, sharding
+
+
+class TestShardedSampler:
+    def test_disjoint_cover_equal_counts(self):
+        """Shards must disjointly cover all 50k examples with equal counts
+        (SURVEY.md §4: 'disjoint cover of 50k examples')."""
+        n, world = 50_000, 4
+        all_idx = [sharding.ShardedSampler(n, world, r).epoch_indices()
+                   for r in range(world)]
+        assert all(len(ix) == 12_500 for ix in all_idx)
+        union = np.concatenate(all_idx)
+        assert len(np.unique(union)) == n
+
+    def test_padding_wraps_like_torch(self):
+        n, world = 10, 4   # ceil(10/4)=3 -> total 12, 2 wrapped
+        all_idx = [sharding.ShardedSampler(n, world, r, shuffle=False)
+                   .epoch_indices() for r in range(world)]
+        flat = np.stack(all_idx).T.reshape(-1)  # undo round-robin deal
+        np.testing.assert_array_equal(flat, np.r_[np.arange(10), [0, 1]])
+
+    def test_no_reshuffle_across_epochs_by_default(self):
+        """Reference never calls sampler.set_epoch -> same permutation every
+        epoch (SURVEY.md C6)."""
+        s = sharding.ShardedSampler(1000, 2, 0)
+        np.testing.assert_array_equal(s.epoch_indices(0), s.epoch_indices(5))
+        s2 = sharding.ShardedSampler(1000, 2, 0, reshuffle_each_epoch=True)
+        assert not np.array_equal(s2.epoch_indices(0), s2.epoch_indices(1))
+
+    def test_global_matrix_matches_per_rank(self):
+        mat = sharding.global_epoch_indices(100, 4)
+        for r in range(4):
+            np.testing.assert_array_equal(
+                mat[r], sharding.ShardedSampler(100, 4, r).epoch_indices())
+
+
+class TestAugment:
+    def test_normalize_stats(self):
+        img = np.full((1, 32, 32, 3), 128, np.uint8)
+        out = np.asarray(augment.normalize(jnp.asarray(img)))
+        expected = (128 / 255.0 - cifar10.MEAN) / cifar10.STD
+        np.testing.assert_allclose(out[0, 0, 0], expected, atol=1e-6)
+
+    def test_augment_shapes_and_determinism(self):
+        imgs = np.random.default_rng(0).integers(
+            0, 256, (8, 32, 32, 3)).astype(np.uint8)
+        key = jax.random.PRNGKey(0)
+        a = augment.augment(key, jnp.asarray(imgs))
+        b = augment.augment(key, jnp.asarray(imgs))
+        assert a.shape == (8, 32, 32, 3)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = augment.augment(jax.random.PRNGKey(1), jnp.asarray(imgs))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_augment_is_crop_of_padded(self):
+        """With an all-ones image, any crop/flip output normalizes the same
+        nonzero constant inside, zeros (padding) possibly at borders."""
+        imgs = np.full((4, 32, 32, 3), 255, np.uint8)
+        out = np.asarray(augment.augment(jax.random.PRNGKey(3),
+                                         jnp.asarray(imgs)))
+        interior = out[:, 8:24, 8:24, :]  # never touches pad for offsets<=8
+        expected = (1.0 - cifar10.MEAN) / cifar10.STD
+        np.testing.assert_allclose(
+            interior, np.broadcast_to(expected, interior.shape), atol=1e-5)
+
+
+class TestCifar10:
+    def test_synthetic_fallback_shapes(self, tmp_path):
+        train, test, real = cifar10.load(str(tmp_path))
+        assert not real
+        assert train.images.shape == (50_000, 32, 32, 3)
+        assert train.images.dtype == np.uint8
+        assert test.labels.shape == (10_000,)
+        assert train.labels.min() >= 0 and train.labels.max() <= 9
+
+    def test_synthetic_is_deterministic(self, tmp_path):
+        t1, _, _ = cifar10.load(str(tmp_path))
+        t2, _, _ = cifar10.load(str(tmp_path))
+        np.testing.assert_array_equal(t1.images, t2.images)
+
+    def test_real_pickle_loader(self, tmp_path):
+        """Write a fake cifar-10-batches-py dir in the on-disk format."""
+        import pickle
+        d = tmp_path / "cifar-10-batches-py"
+        d.mkdir()
+        rng = np.random.default_rng(0)
+        for i in range(1, 6):
+            data = rng.integers(0, 256, (100, 3072)).astype(np.uint8)
+            with open(d / f"data_batch_{i}", "wb") as f:
+                pickle.dump({b"data": data,
+                             b"labels": list(rng.integers(0, 10, 100))}, f)
+        with open(d / "test_batch", "wb") as f:
+            pickle.dump({b"data": rng.integers(0, 256, (50, 3072)).astype(
+                np.uint8), b"labels": list(rng.integers(0, 10, 50))}, f)
+        train, test, real = cifar10.load(str(tmp_path))
+        assert real
+        assert train.images.shape == (500, 32, 32, 3)
+        assert test.images.shape == (50, 32, 32, 3)

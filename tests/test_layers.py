@@ -1,0 +1,96 @@
+"""Layer-level parity tests against PyTorch (CPU).
+
+The reference model is torch.nn modules (/root/reference/src/Part 1/model.py);
+these tests pin our functional layers to the same math: conv/linear forward
+agreement under weight transplant, BatchNorm train/eval semantics including
+running-stat updates, and torch-default init distributions.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+import jax
+import jax.numpy as jnp
+
+from cs744_ddp_tpu.models import layers
+
+
+def test_conv2d_matches_torch():
+    torch.manual_seed(0)
+    tconv = nn.Conv2d(3, 8, 3, stride=1, padding=1, bias=True)
+    x = np.random.default_rng(0).normal(size=(2, 5, 5, 3)).astype(np.float32)
+
+    params = {
+        # torch weight OIHW -> our HWIO
+        "w": jnp.asarray(tconv.weight.detach().numpy().transpose(2, 3, 1, 0)),
+        "b": jnp.asarray(tconv.bias.detach().numpy()),
+    }
+    ours = layers.conv2d_apply(params, jnp.asarray(x))
+    theirs = tconv(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    theirs = theirs.detach().numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-5)
+
+
+def test_linear_matches_torch():
+    torch.manual_seed(1)
+    tl = nn.Linear(16, 10)
+    x = np.random.default_rng(1).normal(size=(4, 16)).astype(np.float32)
+    params = {"w": jnp.asarray(tl.weight.detach().numpy().T),
+              "b": jnp.asarray(tl.bias.detach().numpy())}
+    ours = layers.linear_apply(params, jnp.asarray(x))
+    theirs = tl(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-5)
+
+
+def test_batchnorm_train_and_eval_match_torch():
+    torch.manual_seed(2)
+    tbn = nn.BatchNorm2d(4)
+    x = np.random.default_rng(2).normal(size=(3, 6, 6, 4)).astype(np.float32)
+    params = {"gamma": jnp.ones(4), "beta": jnp.zeros(4)}
+    state = {"mean": jnp.zeros(4), "var": jnp.ones(4)}
+
+    # Two training steps: outputs AND running-stat trajectories must agree.
+    tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
+    for _ in range(2):
+        ours, state = layers.batchnorm_apply(params, state, jnp.asarray(x),
+                                             train=True)
+        theirs = tbn(tx).detach().numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(state["mean"]),
+                               tbn.running_mean.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(state["var"]),
+                               tbn.running_var.numpy(), atol=1e-5)
+
+    # Eval mode uses running stats.
+    tbn.eval()
+    ours_eval, _ = layers.batchnorm_apply(params, state, jnp.asarray(x),
+                                          train=False)
+    theirs_eval = tbn(tx).detach().numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(ours_eval), theirs_eval, atol=1e-5)
+
+
+def test_maxpool_matches_torch():
+    x = np.random.default_rng(3).normal(size=(2, 8, 8, 3)).astype(np.float32)
+    ours = layers.maxpool2x2(jnp.asarray(x))
+    theirs = nn.MaxPool2d(2, 2)(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    np.testing.assert_allclose(np.asarray(ours),
+                               theirs.numpy().transpose(0, 2, 3, 1), atol=1e-6)
+
+
+def test_torch_default_init_bounds():
+    """Conv/linear init must be U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+    key = jax.random.PRNGKey(0)
+    p = layers.conv2d_init(key, 16, 32, 3)
+    bound = 1.0 / np.sqrt(16 * 9)
+    w = np.asarray(p["w"])
+    assert w.min() >= -bound and w.max() <= bound
+    # A uniform on [-b,b] has std b/sqrt(3); check within 5%.
+    assert abs(w.std() - bound / np.sqrt(3)) < 0.05 * bound
+    assert np.asarray(p["b"]).min() >= -bound
+
+    p = layers.linear_init(key, 512, 10)
+    bound = 1.0 / np.sqrt(512)
+    w = np.asarray(p["w"])
+    assert w.min() >= -bound and w.max() <= bound

@@ -1,0 +1,26 @@
+"""Cross-entropy parity vs torch.nn.CrossEntropyLoss (reference criterion,
+/root/reference/src/Part 1/main.py:110)."""
+
+import numpy as np
+import torch
+
+import jax.numpy as jnp
+
+from cs744_ddp_tpu.ops.loss import accuracy_counts, cross_entropy
+
+
+def test_cross_entropy_matches_torch():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(16, 10)).astype(np.float32) * 3
+    labels = rng.integers(0, 10, size=16).astype(np.int64)
+    ours = float(cross_entropy(jnp.asarray(logits),
+                               jnp.asarray(labels.astype(np.int32))))
+    theirs = float(torch.nn.CrossEntropyLoss()(
+        torch.from_numpy(logits), torch.from_numpy(labels)))
+    assert abs(ours - theirs) < 1e-5
+
+
+def test_accuracy_counts():
+    logits = jnp.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    labels = jnp.asarray([1, 0, 0])
+    assert int(accuracy_counts(logits, labels)) == 2

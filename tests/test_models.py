@@ -1,0 +1,117 @@
+"""Model zoo tests: shapes, parameter counts, full-model torch parity.
+
+Parameter count oracle: the reference VGG-11 variant (10 classes, 512->10
+head) has 9,231,114 parameters (SURVEY.md §4 cites ~9.2M).
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+import jax
+import jax.numpy as jnp
+
+from cs744_ddp_tpu.models import get_model, resnet, vgg
+
+
+def n_params(tree):
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(tree))
+
+
+def torch_vgg11():
+    """The reference's _VGG('VGG11') rebuilt verbatim-semantics in torch
+    (reference /root/reference/src/Part 1/model.py:11-46)."""
+    cfg = [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"]
+    layers_, in_ch = [], 3
+    for c in cfg:
+        if c == "M":
+            layers_.append(nn.MaxPool2d(2, 2))
+        else:
+            layers_ += [nn.Conv2d(in_ch, c, 3, 1, 1, bias=True),
+                        nn.BatchNorm2d(c), nn.ReLU(inplace=True)]
+            in_ch = c
+    features = nn.Sequential(*layers_)
+
+    class VGG(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.layers = features
+            self.fc1 = nn.Linear(512, 10)
+
+        def forward(self, x):
+            y = self.layers(x)
+            return self.fc1(y.view(y.size(0), -1))
+
+    return VGG()
+
+
+def test_vgg11_param_count_matches_torch():
+    params, state = vgg.init(jax.random.PRNGKey(0), "VGG11")
+    tmodel = torch_vgg11()
+    torch_count = sum(p.numel() for p in tmodel.parameters())
+    assert n_params(params) == torch_count == 9_231_114
+    # BN running stats count, too (state tree).
+    torch_buffers = sum(b.numel() for n, b in tmodel.named_buffers()
+                        if "running" in n)
+    assert n_params(state) == torch_buffers
+
+
+@pytest.mark.parametrize("name,expected_convs",
+                         [("VGG11", 8), ("VGG13", 10), ("VGG16", 13),
+                          ("VGG19", 16)])
+def test_vgg_family_structure(name, expected_convs):
+    params, state = vgg.init(jax.random.PRNGKey(0), name)
+    assert len(params["conv"]) == expected_convs
+    assert len(state["bn"]) == expected_convs
+    logits, new_state = vgg.apply(params, state,
+                                  jnp.zeros((2, 32, 32, 3)), train=True,
+                                  name=name)
+    assert logits.shape == (2, 10)
+
+
+def test_vgg11_forward_matches_torch_with_transplanted_weights():
+    """Transplant torch weights into our pytree; logits must agree."""
+    torch.manual_seed(0)
+    tmodel = torch_vgg11().eval()
+    params, state = vgg.init(jax.random.PRNGKey(0), "VGG11")
+
+    convs = [m for m in tmodel.layers if isinstance(m, nn.Conv2d)]
+    bns = [m for m in tmodel.layers if isinstance(m, nn.BatchNorm2d)]
+    params["conv"] = [
+        {"w": jnp.asarray(c.weight.detach().numpy().transpose(2, 3, 1, 0)),
+         "b": jnp.asarray(c.bias.detach().numpy())} for c in convs]
+    params["bn"] = [
+        {"gamma": jnp.asarray(b.weight.detach().numpy()),
+         "beta": jnp.asarray(b.bias.detach().numpy())} for b in bns]
+    state["bn"] = [
+        {"mean": jnp.asarray(b.running_mean.numpy()),
+         "var": jnp.asarray(b.running_var.numpy())} for b in bns]
+    params["fc1"] = {"w": jnp.asarray(tmodel.fc1.weight.detach().numpy().T),
+                     "b": jnp.asarray(tmodel.fc1.bias.detach().numpy())}
+
+    x = np.random.default_rng(0).normal(
+        scale=1.0, size=(4, 32, 32, 3)).astype(np.float32)
+    ours, _ = vgg.apply(params, state, jnp.asarray(x), train=False)
+    theirs = tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2))).detach().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
+
+
+def test_resnet18_shapes_and_count():
+    params, state = resnet.init(jax.random.PRNGKey(0))
+    # CIFAR ResNet-18 (3x3 stem, 10-class head): 11,173,962 params.
+    assert n_params(params) == 11_173_962
+    logits, ns = resnet.apply(params, state, jnp.zeros((2, 32, 32, 3)),
+                              train=True)
+    assert logits.shape == (2, 10)
+
+
+def test_get_model_registry():
+    for name in ("vgg11", "vgg16", "resnet18"):
+        init_fn, apply_fn = get_model(name)
+        params, state = init_fn(jax.random.PRNGKey(1))
+        logits, _ = apply_fn(params, state, jnp.zeros((1, 32, 32, 3)),
+                             train=False)
+        assert logits.shape == (1, 10)
+    with pytest.raises(ValueError):
+        get_model("alexnet")

@@ -1,0 +1,71 @@
+"""Measure the reference stack's throughput on this host: torch CPU VGG-11,
+batch 256, SGD(0.1, 0.9, 1e-4) — the reference's exact training config
+(/root/reference/src/Part 1/main.py:110-115) on synthetic data.
+
+This supplies the vs_baseline denominator for bench.py, since the reference
+publishes no numbers (BASELINE.json "published": {}).  Run:
+    python tools/bench_torch_baseline.py [iters]
+"""
+
+import sys
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+
+def build_vgg11():
+    cfg = [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"]
+    layers, in_ch = [], 3
+    for c in cfg:
+        if c == "M":
+            layers.append(nn.MaxPool2d(2, 2))
+        else:
+            layers += [nn.Conv2d(in_ch, c, 3, 1, 1, bias=True),
+                       nn.BatchNorm2d(c), nn.ReLU(inplace=True)]
+            in_ch = c
+
+    class VGG(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.layers = nn.Sequential(*layers)
+            self.fc1 = nn.Linear(512, 10)
+
+        def forward(self, x):
+            y = self.layers(x)
+            return self.fc1(y.view(y.size(0), -1))
+
+    return VGG()
+
+
+def main():
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+    torch.manual_seed(0)
+    torch.set_num_threads(4)  # reference: Part 1/main.py:11
+    model = build_vgg11()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9,
+                          weight_decay=1e-4)
+    crit = nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    x = torch.from_numpy(rng.normal(size=(256, 3, 32, 32)).astype(np.float32))
+    y = torch.from_numpy(rng.integers(0, 10, 256).astype(np.int64))
+
+    # warmup
+    opt.zero_grad()
+    crit(model(x), y).backward()
+    opt.step()
+
+    t0 = time.time()
+    for _ in range(iters):
+        opt.zero_grad()
+        loss = crit(model(x), y)
+        loss.backward()
+        opt.step()
+    dt = (time.time() - t0) / iters
+    print(f"torch CPU VGG-11 batch 256: {dt:.3f} s/iter, "
+          f"{256 / dt:.1f} images/sec")
+
+
+if __name__ == "__main__":
+    main()
