@@ -37,7 +37,7 @@ def main() -> None:
                       num_devices=ndev, global_batch=256,
                       data_dir=os.environ.get("CIFAR_DATA_DIR", "./data"),
                       log=lambda s: print(s, file=sys.stderr))
-    ips, ips_per_chip = trainer.steady_state_throughput(max_iters=60)
+    ips, ips_per_chip = trainer.steady_state_throughput(max_iters=200)
     print(json.dumps({
         "metric": "cifar10_vgg11_images_per_sec_per_chip",
         "value": round(ips_per_chip, 2),

@@ -36,12 +36,9 @@ def _crop_one(img: jax.Array, off: jax.Array) -> jax.Array:
                                  (32, 32, 3))
 
 
-def augment(key: jax.Array, images_u8: jax.Array) -> jax.Array:
-    """Random pad-4 crop + hflip + normalize. images_u8: [N,32,32,3] uint8.
-
-    Per-example randomness comes from a single fold of the step key —
-    deterministic given (seed, step), independent of device count.
-    """
+def augment_gather(key: jax.Array, images_u8: jax.Array) -> jax.Array:
+    """Reference formulation: vmap'd dynamic_slice crop (lowers to gathers —
+    fine on CPU, slow on TPU; kept as the semantics oracle for tests)."""
     n = images_u8.shape[0]
     kc, kf = jax.random.split(key)
     offs = jax.random.randint(kc, (n, 2), 0, 9, dtype=jnp.int32)
@@ -52,3 +49,42 @@ def augment(key: jax.Array, images_u8: jax.Array) -> jax.Array:
     flipped = jnp.where(flips[:, None, None, None],
                         cropped[:, :, ::-1, :], cropped)
     return normalize(flipped)
+
+
+def augment(key: jax.Array, images_u8: jax.Array) -> jax.Array:
+    """Random pad-4 crop + hflip + normalize. images_u8: [N,32,32,3] uint8.
+
+    TPU-native formulation: the per-example crop/flip is expressed as two
+    batched ONE-HOT MATMULS (row-select, then column-select with the flip
+    folded into the column one-hot), so the whole augmentation rides the MXU
+    instead of lowering to per-example gathers (which serialize on TPU).
+    One-hot selection sums pick exactly one term, and uint8 values (<=255)
+    are exact in bfloat16, so the result is bit-identical to the gather
+    formulation (pinned by tests/test_data.py).
+
+    Per-example randomness comes from a single fold of the step key —
+    deterministic given (seed, step), independent of device count.
+    """
+    n = images_u8.shape[0]
+    kc, kf = jax.random.split(key)
+    offs = jax.random.randint(kc, (n, 2), 0, 9, dtype=jnp.int32)
+    flips = jax.random.bernoulli(kf, 0.5, (n,))
+
+    padded = jnp.pad(images_u8, ((0, 0), (4, 4), (4, 4), (0, 0)))
+    pads = padded.astype(jnp.bfloat16)
+
+    # Row selector R[n, i, h] = 1 iff h == i + oy[n]       ([N,32,40])
+    i32 = jnp.arange(32, dtype=jnp.int32)
+    h40 = jnp.arange(40, dtype=jnp.int32)
+    rows = (i32[None, :, None] + offs[:, 0][:, None, None]) == h40[None, None, :]
+    # Column selector C[n, w, j] = 1 iff w == ox[n] + (31-j if flip else j)
+    j32 = jnp.where(flips[:, None], 31 - i32[None, :], i32[None, :])
+    target = j32 + offs[:, 1][:, None]                   # [N,32] source col
+    cols = h40[None, :, None] == target[:, None, :]      # [N,40,32]
+
+    r = rows.astype(jnp.bfloat16)
+    c = cols.astype(jnp.bfloat16)
+    # [N,32,40] @ [N,40,40,3] -> [N,32,40,3]; then cols: -> [N,32,32,3]
+    picked_rows = jnp.einsum("nih,nhwc->niwc", r, pads)
+    cropped = jnp.einsum("niwc,nwj->nijc", picked_rows, c)
+    return normalize(cropped.astype(jnp.uint8))

@@ -60,6 +60,17 @@ class TestAugment:
         c = augment.augment(jax.random.PRNGKey(1), jnp.asarray(imgs))
         assert not np.array_equal(np.asarray(a), np.asarray(c))
 
+    def test_matmul_formulation_equals_gather_formulation(self):
+        """The MXU one-hot-matmul crop/flip must be BIT-identical to the
+        dynamic_slice gather formulation (uint8 is exact in bf16)."""
+        imgs = np.random.default_rng(9).integers(
+            0, 256, (32, 32, 32, 3)).astype(np.uint8)
+        for seed in (0, 1, 2):
+            key = jax.random.PRNGKey(seed)
+            a = np.asarray(augment.augment(key, jnp.asarray(imgs)))
+            b = np.asarray(augment.augment_gather(key, jnp.asarray(imgs)))
+            np.testing.assert_array_equal(a, b)
+
     def test_augment_is_crop_of_padded(self):
         """With an all-ones image, any crop/flip output normalizes the same
         nonzero constant inside, zeros (padding) possibly at borders."""

@@ -121,6 +121,51 @@ def test_single_matches_eight_way_ddp(tmp_path, mesh1, mesh8):
         assert np.max(np.abs(a - b)) < 0.6, "divergence beyond BN-stat noise"
 
 
+def test_windowed_path_matches_per_step_path(tmp_path, mesh8):
+    """A W-step compiled window must produce the same TrainState as W
+    individual per-step calls (augment off so PRNG streams are moot)."""
+    tr_win = make_trainer(tmp_path, mesh8, "ddp")
+    tr_step = make_trainer(tmp_path, mesh8, "ddp")
+    n_iters = 7
+    # Shrink BOTH trainers to the same n_iters-batch epoch (the sampler
+    # permutation depends on the dataset size, so the splits must match).
+    for tr in (tr_win, tr_step):
+        tr.train_split = cifar10.Split(
+            tr.train_split.images[:64 * n_iters],
+            tr.train_split.labels[:64 * n_iters])
+    tr_win.train_model(0)
+
+    key = jax.random.fold_in(jax.random.PRNGKey(tr_step.seed), 0)
+    for it, (imgs, labs) in enumerate(_shard_batches(
+            tr_step.train_split, tr_step.world, 64, 0, shuffle=True)):
+        if it >= n_iters:
+            break
+        x, y = tr_step._put(imgs, labs)
+        tr_step.state, _ = tr_step.train_step(
+            tr_step.state, jax.random.fold_in(key, it), x, y)
+
+    # Tolerance: scan vs unrolled dispatch compile to different programs,
+    # so fp32 reassociation gives ~1e-5-level divergence over 7 steps.
+    params_allclose(tr_win.state.params, tr_step.state.params, atol=1e-4)
+    params_allclose(tr_win.state.opt_state.momentum,
+                    tr_step.state.opt_state.momentum, atol=1e-4)
+    # Running variance accumulates squared activations — more fp-sensitive.
+    params_allclose(tr_win.state.bn_state, tr_step.state.bn_state, atol=1e-3)
+
+
+def test_staging_cache_invalidates_on_split_replacement(tmp_path, mesh4):
+    """Replacing test_split after an eval must restage (not reuse stale
+    device arrays)."""
+    tr = make_trainer(tmp_path, mesh4, "allreduce")
+    tr.test_split = cifar10.Split(tr.test_split.images[:128],
+                                  tr.test_split.labels[:128])
+    _, correct_full, _ = tr.test_model()
+    tr.test_split = cifar10.Split(tr.test_split.images[:64],
+                                  tr.test_split.labels[:64])
+    _, correct_small, _ = tr.test_model()
+    assert correct_small <= 64  # would exceed 64 if stale staging were used
+
+
 def test_loss_decreases_single_device(tmp_path, mesh1):
     """The reference's convergence oracle: running loss drops (SURVEY.md §4).
     Synthetic data is class-templated, so a few steps cut loss sharply."""
