@@ -8,10 +8,15 @@ spectrum (SURVEY.md §2.3):
   * ``gather_scatter``  — reference Part 2a (``main.py:117-127``):
     per parameter, rank 0 gathers every worker's grad, means them, scatters
     the average back.  Here: per leaf, ``all_gather`` (a superset of
-    gather-to-root on ICI), the mean is computed only on mesh position 0 and
-    broadcast via a masked ``psum`` — two sequential collectives per leaf
-    with root-located compute, preserving the deliberately-naive
-    communication shape for honest benchmarking.
+    gather-to-root on ICI), then the gathered stack is zeroed on every mesh
+    position except 0 *before* the mean — so the only mean value that
+    reaches the result is the one computed at the root (non-root positions
+    reduce zeros) — and the root's mean is broadcast via ``psum``.  Two
+    sequential collectives per leaf with root-located compute, preserving
+    the deliberately-naive communication shape for honest benchmarking.
+    (SPMD executes the same program text everywhere; "root-located" means
+    the root's arithmetic is the only contribution to the output, exactly
+    as rank 0's ``torch.mean`` is in the reference.)
 
   * ``per_param_psum``  — reference Part 2b (``main.py:116-119``):
     one all-reduce per parameter leaf, then divide by world size.  Here: one
@@ -65,9 +70,12 @@ def gather_scatter(grads: Any, axis_name: str) -> Any:
 
     def leaf(g):
         gathered = lax.all_gather(g, axis_name)          # collective 1 (gather)
-        mean = jnp.mean(gathered, axis=0)                # compute on every
-        root_only = jnp.where(idx == 0, mean, jnp.zeros_like(mean))
-        return lax.psum(root_only, axis_name)            # collective 2 (scatter/bcast)
+        # Mask BEFORE the mean: non-root positions reduce zeros, so the
+        # mean that survives the psum is computed at mesh position 0 only —
+        # root-located compute, like rank 0's torch.mean in the reference.
+        rooted = jnp.where(idx == 0, gathered, jnp.zeros_like(gathered))
+        mean = jnp.mean(rooted, axis=0)
+        return lax.psum(mean, axis_name)                 # collective 2 (scatter/bcast)
 
     return jax.tree.map(leaf, grads)
 

@@ -93,6 +93,11 @@ class Trainer:
         # call — SURVEY.md C6); opt in for proper per-epoch reshuffling.
         self.reshuffle_each_epoch = reshuffle_each_epoch
 
+        # Split-replacement generations: staging caches key on these, so
+        # swapping a split always restages (id() reuse after GC cannot serve
+        # stale device arrays).  Must exist before the property assignments.
+        self._train_gen = 0
+        self._test_gen = 0
         self.train_split, self.test_split, self.real_data = cifar10.load(data_dir)
         # Reference parity: these lines print len(train_loader) — the
         # per-rank BATCH count, not the example count (Part 2a/main.py:46,55).
@@ -101,10 +106,17 @@ class Trainer:
 
         per_rank_samples = ceil_div(len(self.train_split.labels), self.world)
         per_rank_batch = global_batch // self.world
+        # NOTE: the printed count is ceil (DataLoader drop_last=False parity,
+        # 782 at 50000/64); training itself drops the ragged final batch for
+        # static XLA shapes, so actual iterations are the floor (781).  Both
+        # the drop and this off-by-one are documented in BASELINE.md.
         self.log(f"Size of training set is "
                  f"{ceil_div(per_rank_samples, per_rank_batch)}")
+        # The reference's test loader uses the PER-RANK batch (256/world,
+        # Part 2a/main.py:50-54) over the UNsharded 10k test set, so its
+        # printed size is ceil(10000/(256/world)).
         self.log(f"Size of test set is "
-                 f"{ceil_div(len(self.test_split.labels), global_batch)}")
+                 f"{ceil_div(len(self.test_split.labels), per_rank_batch)}")
 
         # `model` is a registry name ("vgg11", "resnet18", ...) or a custom
         # (init_fn, apply_fn) pair (used by tests to keep compiles small).
@@ -134,6 +146,26 @@ class Trainer:
         self._staged_train = None   # (epoch_images, epoch_labels) on device
         self._staged_eval = None
         self.last_epoch_timers: Optional[WindowedTimers] = None
+
+    # -- dataset splits (generation-tracked for staging-cache keys) ---------
+
+    @property
+    def train_split(self) -> cifar10.Split:
+        return self._train_split
+
+    @train_split.setter
+    def train_split(self, split: cifar10.Split) -> None:
+        self._train_split = split
+        self._train_gen += 1
+
+    @property
+    def test_split(self) -> cifar10.Split:
+        return self._test_split
+
+    @test_split.setter
+    def test_split(self, split: cifar10.Split) -> None:
+        self._test_split = split
+        self._test_gen += 1
 
     # -- device placement ---------------------------------------------------
 
@@ -167,11 +199,11 @@ class Trainer:
         One host->device transfer per epoch instead of one per batch —
         transfers carry a large fixed cost, and the uint8 epoch is ~150 MB.
         With the reference's never-reshuffled sampler (C6) the staging is
-        reused across epochs; the cache is keyed on the split object and
-        (when reshuffling) the epoch, so replacing ``train_split`` or
-        enabling reshuffle restages.
+        reused across epochs; the cache is keyed on the split GENERATION
+        (bumped by the train_split setter) and (when reshuffling) the epoch,
+        so replacing ``train_split`` or enabling reshuffle restages.
         """
-        cache_key = (id(self.train_split),
+        cache_key = (self._train_gen,
                      epoch if self.reshuffle_each_epoch else 0)
         if self._staged_train is not None and \
                 self._staged_train[0] == cache_key:
@@ -207,7 +239,7 @@ class Trainer:
                 jnp.zeros((w,), jnp.int8)).compile()
 
     def _stage_eval(self):
-        cache_key = id(self.test_split)
+        cache_key = self._test_gen
         if self._staged_eval is not None and \
                 self._staged_eval[0] == cache_key:
             return self._staged_eval[1]
@@ -258,7 +290,8 @@ class Trainer:
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
         for it, (imgs, labs) in enumerate(_shard_batches(
                 self.train_split, self.world, self.global_batch, epoch,
-                shuffle=True, seed=self.seed)):
+                shuffle=True, seed=self.seed,
+                reshuffle_each_epoch=self.reshuffle_each_epoch)):
             step_key = jax.random.fold_in(key, it)
             x, y = self._put(imgs, labs)
             t0 = time.time()
@@ -318,15 +351,18 @@ class Trainer:
         nwin = max(2, max_iters // w)
         starts = [i * w for i in range(max(nbatches // w, 1))] or [0]
 
-        def dispatch(start):
+        def dispatch(start, wi):
+            # Fold the dispatch counter in: when the start offsets wrap
+            # around a small epoch, the same batches get FRESH augmentation
+            # randomness instead of replaying the previous pass's stream.
             self.state, losses = self.train_window(
-                self.state, key, epoch_images, epoch_labels,
-                jnp.int32(start), length_arr)
+                self.state, jax.random.fold_in(key, wi), epoch_images,
+                epoch_labels, jnp.int32(start), length_arr)
             return losses
 
         # Window 0: compile + warmup (excluded, as the reference excludes its
         # first 20-iteration window).  Fetching the losses is the fence.
-        _ = np.asarray(dispatch(0))
+        _ = np.asarray(dispatch(0, 0))
         # Steady state: windows dispatch back-to-back — the state pytree
         # chains every step sequentially on device — and all losses are
         # fetched after the last window, which transitively fences the whole
@@ -335,7 +371,7 @@ class Trainer:
         t0 = time.time()
         pending = []
         for i in range(nwin):
-            pending.append(dispatch(starts[(1 + i) % len(starts)]))
+            pending.append(dispatch(starts[(1 + i) % len(starts)], 1 + i))
         for losses in pending:
             _ = np.asarray(losses)
         elapsed = time.time() - t0

@@ -139,7 +139,14 @@ def make_train_window(apply_fn: Callable,
         def one(carry, xs):
             params, bn_state, opt_state, key = carry
             images, labels, idx = xs
+            # Canonical fold order across ALL execution paths: batch index
+            # first, mesh position second — the per-step path folds the
+            # iteration on the host (loop.py) and the position in
+            # make_train_step, so with the same order here the windowed and
+            # per-step paths consume identical augmentation streams.
             k = jax.random.fold_in(key, idx)
+            if axis_ok:
+                k = jax.random.fold_in(k, lax.axis_index(DATA_AXIS))
             x = aug.augment(k, images) if augment else aug.normalize(images)
 
             def loss_fn(p):
@@ -162,8 +169,6 @@ def make_train_window(apply_fn: Callable,
     def window_body(params, bn_state, opt_state, key, epoch_images,
                     epoch_labels, start, length_arr):
         w = length_arr.shape[0]
-        if not single:
-            key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
         imgs = lax.dynamic_slice_in_dim(epoch_images, start, w, axis=0)
         labs = lax.dynamic_slice_in_dim(epoch_labels, start, w, axis=0)
         idxs = start + jnp.arange(w, dtype=jnp.int32)
@@ -236,8 +241,8 @@ def make_eval_window(apply_fn: Callable, mesh: Mesh) -> Callable:
             return (l + loss_sum, c + correct), None
         # Initial carry must already be marked device-varying (each shard
         # accumulates its own partial sums) for shard_map's VMA typing.
-        init = (lax.pvary(jnp.float32(0.0), DATA_AXIS),
-                lax.pvary(jnp.int32(0), DATA_AXIS))
+        init = (lax.pcast(jnp.float32(0.0), DATA_AXIS, to="varying"),
+                lax.pcast(jnp.int32(0), DATA_AXIS, to="varying"))
         (loss_sum, correct), _ = lax.scan(one, init, (images, labels))
         return loss_sum, correct
 

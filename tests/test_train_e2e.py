@@ -153,6 +153,32 @@ def test_windowed_path_matches_per_step_path(tmp_path, mesh8):
     params_allclose(tr_win.state.bn_state, tr_step.state.bn_state, atol=1e-3)
 
 
+def test_windowed_path_matches_per_step_path_with_augment(tmp_path, mesh4):
+    """With the canonical PRNG fold order (batch index, then mesh position)
+    the windowed and per-step paths must consume the SAME augmentation
+    stream — this pins ADVICE r1's fold-order divergence as fixed."""
+    tr_win = make_trainer(tmp_path, mesh4, "ddp", augment=True)
+    tr_step = make_trainer(tmp_path, mesh4, "ddp", augment=True)
+    n_iters = 4
+    for tr in (tr_win, tr_step):
+        tr.train_split = cifar10.Split(
+            tr.train_split.images[:64 * n_iters],
+            tr.train_split.labels[:64 * n_iters])
+    tr_win.train_model(0)
+
+    key = jax.random.fold_in(jax.random.PRNGKey(tr_step.seed), 0)
+    for it, (imgs, labs) in enumerate(_shard_batches(
+            tr_step.train_split, tr_step.world, 64, 0, shuffle=True)):
+        if it >= n_iters:
+            break
+        x, y = tr_step._put(imgs, labs)
+        tr_step.state, _ = tr_step.train_step(
+            tr_step.state, jax.random.fold_in(key, it), x, y)
+
+    # Same stream => same data => scan-vs-unrolled fp divergence only.
+    params_allclose(tr_win.state.params, tr_step.state.params, atol=1e-4)
+
+
 def test_staging_cache_invalidates_on_split_replacement(tmp_path, mesh4):
     """Replacing test_split after an eval must restage (not reuse stale
     device arrays)."""
