@@ -42,6 +42,19 @@ def initialize_distributed(coordinator: Optional[str] = None,
         # silently training N independent copies would be wrong.
         raise ValueError("multi-process run (num_processes "
                          f"= {num_processes}) requires a coordinator address")
+    # Cross-process collectives on the CPU backend need an implementation;
+    # gloo — the reference's own backend (Part 2a/main.py:148) — is the
+    # fitting choice.  Inert for TPU meshes (collectives ride ICI/DCN).
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError as e:
+        # Config renamed/absent on this JAX version: a CPU multi-process run
+        # would fail at the first collective, so say why NOW; TPU meshes
+        # don't consult it and proceed fine.
+        import warnings
+        warnings.warn(f"could not enable gloo CPU collectives ({e}); "
+                      "multi-process CPU runs will fail at the first "
+                      "collective, TPU runs are unaffected")
     addr = coordinator if ":" in coordinator else f"{coordinator}:{port}"
     jax.distributed.initialize(coordinator_address=addr,
                                num_processes=num_processes,
@@ -68,3 +81,27 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def put_global(array, sharding: NamedSharding) -> jax.Array:
+    """Place a host array as a GLOBAL array under ``sharding``, safely on
+    meshes that span multiple processes.
+
+    Single-process: a plain ``device_put`` (the fast batched-transfer path).
+    Multi-process: ``device_put`` of a host-global value raises on meshes
+    containing non-addressable devices, so each process instead feeds only
+    its addressable devices' index-slices via ``make_array_from_callback``.
+    Every process passes the same host value — the framework's seed-identical
+    invariant (SURVEY.md C12: the reference relies on identical seeds instead
+    of a broadcast), which makes the per-process slices globally consistent.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(array, sharding)
+    array = np.asarray(array)
+    return jax.make_array_from_callback(
+        array.shape, sharding, lambda idx: array[idx])
+
+
+def put_global_tree(tree, sharding: NamedSharding):
+    """``put_global`` over every leaf of a pytree (e.g. a TrainState)."""
+    return jax.tree.map(lambda a: put_global(a, sharding), tree)

@@ -128,8 +128,10 @@ class Trainer:
             init_fn, jax.random.PRNGKey(seed))
         # Commit the state to the mesh (replicated) up front: otherwise the
         # first windowed call sees uncommitted arrays and the second call a
-        # different sharding signature -> a full recompile.
-        self.state = jax.device_put(self.state, meshlib.replicated(self.mesh))
+        # different sharding signature -> a full recompile.  put_global_tree
+        # keeps this correct when the mesh spans multiple processes.
+        self.state = meshlib.put_global_tree(
+            self.state, meshlib.replicated(self.mesh))
         self.strategy_name = strategy
         strat = get_strategy(strategy)
         self.train_step = steplib.make_train_step(
@@ -170,8 +172,9 @@ class Trainer:
     # -- device placement ---------------------------------------------------
 
     def _put(self, images: np.ndarray, labels: np.ndarray):
-        return (jax.device_put(images, self._batch_sharding),
-                jax.device_put(jnp.asarray(labels), self._batch_sharding))
+        return (meshlib.put_global(images, self._batch_sharding),
+                meshlib.put_global(np.asarray(labels, np.int32),
+                                   self._batch_sharding))
 
     def _make_fwd_only(self):
         from jax.sharding import PartitionSpec as P
@@ -216,9 +219,9 @@ class Trainer:
             imgs.append(i)
             labs.append(l)
         staged = (
-            jax.device_put(np.stack(imgs), self._epoch_sharding),
-            jax.device_put(np.stack(labs).astype(np.int32),
-                           self._epoch_sharding))
+            meshlib.put_global(np.stack(imgs), self._epoch_sharding),
+            meshlib.put_global(np.stack(labs).astype(np.int32),
+                               self._epoch_sharding))
         self._staged_train = (cache_key, staged)
         self._warm_train_windows(staged)
         return staged
@@ -247,8 +250,8 @@ class Trainer:
         for i, l in _eval_batches(self.test_split, self.global_batch):
             imgs.append(i)
             labs.append(l.astype(np.int32))
-        staged = (jax.device_put(np.stack(imgs), self._epoch_sharding),
-                  jax.device_put(np.stack(labs), self._epoch_sharding))
+        staged = (meshlib.put_global(np.stack(imgs), self._epoch_sharding),
+                  meshlib.put_global(np.stack(labs), self._epoch_sharding))
         self._staged_eval = (cache_key, staged)
         return staged
 

@@ -93,8 +93,17 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
             logits, new_bn = apply_fn(p, bn_state, x, train=True)
             return cross_entropy(logits, labels), new_bn
 
+        # Differentiate w.r.t. a device-VARYING view of the replicated
+        # params: shard_map autodiff auto-psums the cotangent of an
+        # invariant input (the transpose of broadcast is reduce), which
+        # would pre-reduce the grads and leave the strategy's own collective
+        # double-counting by a factor of world.  pcast-to-varying keeps the
+        # grads genuinely shard-local so the strategy below is the ONLY
+        # gradient reduction — its collective pattern, exactly once.
+        params_var = jax.tree.map(
+            lambda a: lax.pcast(a, DATA_AXIS, to="varying"), params)
         (loss, new_bn), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
+            loss_fn, has_aux=True)(params_var)
         grads = strategy(grads, DATA_AXIS)
         new_params, new_opt = sgd.update(params, grads, opt_state, cfg)
         new_bn = jax.tree.map(lambda a: lax.pmean(a, DATA_AXIS), new_bn)
@@ -153,8 +162,13 @@ def make_train_window(apply_fn: Callable,
                 logits, new_bn = apply_fn(p, bn_state, x, train=True)
                 return cross_entropy(logits, labels), new_bn
 
+            # See make_train_step: differentiate w.r.t. a varying view so
+            # the strategy is the only gradient reduction (no autodiff
+            # psum of invariant-param cotangents double-counting it).
+            diff_params = params if not axis_ok else jax.tree.map(
+                lambda a: lax.pcast(a, DATA_AXIS, to="varying"), params)
             (loss, new_bn), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+                loss_fn, has_aux=True)(diff_params)
             grads = strategy_fn(grads)
             new_params, new_opt = sgd.update(params, grads, opt_state, cfg)
             if axis_ok:

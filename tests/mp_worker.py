@@ -1,0 +1,69 @@
+"""Worker process for the 2-process rendezvous test (tests/test_multiprocess.py).
+
+Reproduces the reference's launch model — one manually-launched OS process
+per node, rank from the command line, rendezvous at a coordinator address
+(``/root/reference/src/Part 2a/main.py:148-175``) — with the TPU-native
+runtime: ``jax.distributed.initialize`` (via parallel.mesh), a mesh spanning
+both processes' devices, and gloo cross-process CPU collectives.
+
+Usage: mp_worker.py <process_id> <num_processes> <port> <outdir>
+The launcher must set JAX_PLATFORMS=cpu and
+XLA_FLAGS=--xla_force_host_platform_device_count=4 in the environment.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+N_STEPS = 3
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    outdir = sys.argv[4]
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, tests_dir)                    # tinynet
+    sys.path.insert(0, os.path.dirname(tests_dir))   # cs744_ddp_tpu
+
+    from cs744_ddp_tpu.parallel import mesh as meshlib
+
+    # The runtime under test: rendezvous BEFORE any backend use.
+    meshlib.initialize_distributed("127.0.0.1", nproc, pid, port=port)
+    assert jax.process_count() == nproc, jax.process_count()
+
+    import numpy as np
+
+    from cs744_ddp_tpu.data import cifar10
+    from cs744_ddp_tpu.train.loop import Trainer
+    from tinynet import run_steps, tiny_cnn
+
+    log = lambda s: print(f"[proc {pid}] {s}", flush=True)
+    tr = Trainer(model=tiny_cnn(), strategy="allreduce", global_batch=64,
+                 data_dir=os.path.join(outdir, "data"), augment=False,
+                 log=log)
+    assert tr.world == jax.device_count() == 4 * nproc
+
+    # Losses are fully replicated -> locally readable on every process.
+    losses = run_steps(tr, N_STEPS)
+
+    # Also drive the eval path across the process-spanning mesh.
+    tr.test_split = cifar10.Split(tr.test_split.images[:128],
+                                  tr.test_split.labels[:128])
+    avg_loss, correct, _ = tr.test_model()
+
+    flat = jax.tree.leaves(tr.state.params)
+    np.savez(os.path.join(outdir, f"params_{pid}.npz"),
+             losses=np.asarray(losses, np.float64),
+             eval_loss=np.float64(avg_loss), eval_correct=np.int64(correct),
+             **{f"p{i}": np.asarray(leaf) for i, leaf in enumerate(flat)})
+    log(f"done: losses={losses} eval={avg_loss:.4f}/{correct}")
+
+
+if __name__ == "__main__":
+    main()

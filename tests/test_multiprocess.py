@@ -1,0 +1,90 @@
+"""Multi-process (multi-controller) correctness — VERDICT r1 item 2.
+
+The reference's defining launch model is N separate OS processes
+rendezvousing at a coordinator (``/root/reference/src/Part 2a/main.py:
+148-153`` and the ``--rank`` CLI ``:156-175``).  Here: two real OS
+processes, 4 virtual CPU devices each, ``jax.distributed.initialize`` over
+localhost, gloo cross-process collectives, running the SAME Trainer code
+the single-controller path uses — then the parent asserts
+
+  * both processes hold identical parameters after N allreduce steps
+    (the replicated-state invariant across controller boundaries), and
+  * those parameters match an in-process single-controller run of the
+    identical configuration on the 8-virtual-device mesh (the
+    multi-controller path computes the same mathematics).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+import jax
+
+from cs744_ddp_tpu.data import native
+from cs744_ddp_tpu.train.loop import Trainer
+
+from mp_worker import N_STEPS
+from tinynet import run_steps, tiny_cnn
+
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(_TESTS_DIR)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_rendezvous_matches_single_controller(tmp_path, mesh8):
+    # Pre-build the native library so the workers don't race the first build.
+    native.load_library()
+
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+    port = _free_port()
+    script = os.path.join(_TESTS_DIR, "mp_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, script, str(i), "2", str(port), str(tmp_path)],
+        env=env, cwd=_REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    try:
+        outs = [p.communicate(timeout=600)[0].decode() for p in procs]
+    finally:
+        for p in procs:  # never leak hung workers (e.g. a dead rendezvous)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker {p.args} failed:\n{out}"
+
+    d0 = np.load(tmp_path / "params_0.npz")
+    d1 = np.load(tmp_path / "params_1.npz")
+    assert set(d0.files) == set(d1.files)
+
+    # (1) Cross-process consistency: the replicated state is identical on
+    # both controllers (gloo's reduction gives every process the same sum).
+    for k in d0.files:
+        np.testing.assert_allclose(d0[k], d1[k], rtol=0, atol=1e-6,
+                                   err_msg=f"process disagreement on {k}")
+
+    # (2) Single-controller equivalence: the same config in THIS process on
+    # the 8-virtual-device mesh takes the same steps.
+    tr = Trainer(model=tiny_cnn(), strategy="allreduce", global_batch=64,
+                 data_dir=str(tmp_path / "data"), augment=False,
+                 mesh=mesh8, log=lambda s: None)
+    losses = run_steps(tr, N_STEPS)
+
+    np.testing.assert_allclose(np.asarray(losses, np.float64), d0["losses"],
+                               atol=1e-5)
+    flat = jax.tree.leaves(tr.state.params)
+    assert len(flat) == sum(1 for k in d0.files if k.startswith("p"))
+    for i, leaf in enumerate(flat):
+        np.testing.assert_allclose(
+            np.asarray(leaf), d0[f"p{i}"], atol=1e-5,
+            err_msg=f"single- vs multi-controller divergence on leaf {i}")

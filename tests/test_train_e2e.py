@@ -16,32 +16,11 @@ import jax
 import jax.numpy as jnp
 
 from cs744_ddp_tpu.data import cifar10
-from cs744_ddp_tpu.models import layers
 from cs744_ddp_tpu.ops import sgd
 from cs744_ddp_tpu.ops.loss import cross_entropy
 from cs744_ddp_tpu.train.loop import Trainer, _shard_batches
 
-
-def tiny_cnn():
-    """conv(3->8) + BN + relu + pool(4x) + fc: exercises every layer kind."""
-
-    def init_fn(key, dtype=jnp.float32):
-        k1, k2 = jax.random.split(key)
-        params = {"conv": layers.conv2d_init(k1, 3, 8, 3, dtype)}
-        params["bn"], bn_state = layers.batchnorm_init(8, dtype)
-        params["fc"] = layers.linear_init(k2, 8 * 8 * 8, 10, dtype)
-        return params, {"bn": bn_state}
-
-    def apply_fn(params, state, x, *, train):
-        y = layers.conv2d_apply(params["conv"], x)
-        y, new_bn = layers.batchnorm_apply(params["bn"], state["bn"], y,
-                                           train=train)
-        y = layers.relu(y)
-        y = layers.maxpool2x2(layers.maxpool2x2(y))  # 32 -> 8
-        y = y.reshape(y.shape[0], -1)
-        return layers.linear_apply(params["fc"], y), {"bn": new_bn}
-
-    return init_fn, apply_fn
+from tinynet import run_steps, tiny_cnn, tiny_cnn_nobn
 
 
 def make_trainer(tmp_path, mesh, strategy, **kw):
@@ -116,9 +95,36 @@ def test_single_matches_eight_way_ddp(tmp_path, mesh1, mesh8):
     for xa, xb in zip(jax.tree.leaves(tr1.state.params),
                       jax.tree.leaves(tr8.state.params)):
         a, b = np.asarray(xa), np.asarray(xb)
-        # Empirically ~0.32 max after one lr=0.1 step on the tiny net; a
-        # runaway (wrong grad averaging) lands orders of magnitude higher.
+        # Loose bound: per-replica BN stats (shard size 8 vs 64) are a real
+        # semantic difference.  The TIGHT averaging oracle is the BN-free
+        # test below — this bound once masked a grads×world bug, so it only
+        # documents that BN noise stays bounded, nothing more.
         assert np.max(np.abs(a - b)) < 0.6, "divergence beyond BN-stat noise"
+
+
+def test_single_matches_eight_way_ddp_bnfree_tight(tmp_path, mesh1, mesh8):
+    """The REAL cross-world averaging oracle (VERDICT r1 item 5): with no
+    BatchNorm there is no per-replica batch-stats semantic, so a 1-device
+    run and an 8-way DDP run on the same global batch compute the same
+    mathematics — the mean gradient over the global batch is invariant to
+    how the batch is dealt across shards (the round-robin deal of batch b
+    covers exactly permutation positions [b*64, (b+1)*64) in both worlds).
+    Equality must hold to fp tolerance over several steps."""
+    # lr=0.01: the default 0.1 makes the tiny net's trajectory unstable
+    # (loss grows), and an unstable trajectory amplifies benign fp32
+    # reassociation into O(1) parameter differences — the oracle needs
+    # stable dynamics so only a REAL averaging bug can produce divergence.
+    cfg = sgd.SGDConfig(lr=0.01)
+    tr1 = make_trainer(tmp_path, mesh1, "single", model=tiny_cnn_nobn(),
+                       sgd_cfg=cfg)
+    tr8 = make_trainer(tmp_path, mesh8, "ddp", model=tiny_cnn_nobn(),
+                       sgd_cfg=cfg)
+    for tr in (tr1, tr8):
+        run_steps(tr, 5)
+    # fp32 reassociation (8-way psum vs one batch mean) only — no BN noise.
+    params_allclose(tr1.state.params, tr8.state.params, atol=2e-5)
+    params_allclose(tr1.state.opt_state.momentum,
+                    tr8.state.opt_state.momentum, atol=2e-5)
 
 
 def test_windowed_path_matches_per_step_path(tmp_path, mesh8):
