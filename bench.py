@@ -1,21 +1,29 @@
 """Benchmark: steady-state CIFAR-10 training throughput (images/sec/chip).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line whose head matches the driver contract
+({"metric", "value", "unit", "vs_baseline"}) and which additionally carries
+
+  * ``matrix``  — per-(strategy x model) images/sec/chip over all available
+    chips, the reference's strategy-cost spectrum
+    (``/root/reference/src/Part 2a/main.py:83-112`` vs ``Part 2b`` vs
+    ``Part 3`` — its entire pedagogical point), and
+  * ``scaling`` — a 1..N-device sweep with efficiency vs the 1-device run
+    (the BASELINE.json north star: >=90% efficiency 1->8 chips).  On a
+    1-chip host the sweep is degenerate ({"1": ...}, efficiency 1.0); the
+    harness itself is exercised on the 8-virtual-device CPU mesh in
+    tests/test_bench.py.
 
 Protocol (BASELINE.md): the reference's own measurement design — per-step
 wall-clock fenced with block_until_ready, 20-iteration windows, the first
-window (compile + warmup) excluded — on the flagship config: VGG-11,
-CIFAR-10 (synthetic stand-in when the real set is absent; identical shapes
-and dtypes), global batch 256, SGD(0.1, 0.9, 1e-4), bucketed-fused 'ddp'
-strategy over all available chips.
+window (compile + warmup) excluded — global batch 256, SGD(0.1, 0.9, 1e-4).
 
 vs_baseline: the reference publishes no numbers (BASELINE.json
 "published": {}), so the comparison point is the reference's own stack
 measured on this host — torch CPU VGG-11 fwd+bwd+step at batch 256
-(see BASELINE.md "host torch CPU baseline"; measured at 38.9 images/sec
-on this machine).
+(tools/bench_torch_baseline.py: 38.9 images/sec; see BASELINE.md).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -24,26 +32,97 @@ import sys
 # Measured with tools/bench_torch_baseline.py (38.9 img/s); see BASELINE.md.
 TORCH_CPU_BASELINE_IPS = 38.9
 
+MODELS = ("vgg11", "resnet18")
+STRATEGIES = ("gather", "allreduce", "ddp")
 
-def main() -> None:
-    # Use whatever platform the driver provides (TPU under axon; CPU in CI).
-    import jax
 
+def _throughput(model: str, strategy: str, num_devices, *, global_batch: int,
+                max_iters: int, data_dir: str, log) -> float:
+    """images/sec/chip for one configuration (fresh Trainer + mesh)."""
     from cs744_ddp_tpu.train.loop import Trainer
 
+    trainer = Trainer(model=model, strategy=strategy,
+                      num_devices=num_devices, global_batch=global_batch,
+                      data_dir=data_dir, log=log)
+    _, ips_per_chip = trainer.steady_state_throughput(max_iters=max_iters)
+    return ips_per_chip
+
+
+def run_bench(*, matrix: bool = True, sweep: bool = True,
+              max_iters: int = 100, global_batch: int = 256,
+              models=MODELS, strategies=STRATEGIES,
+              headline_model: str = "vgg11", log=None) -> dict:
+    import jax
+
+    log = log or (lambda s: print(s, file=sys.stderr))
+    data_dir = os.environ.get("CIFAR_DATA_DIR", "./data")
     ndev = len(jax.devices())
-    strategy = "ddp" if ndev > 1 else "single"
-    trainer = Trainer(model="vgg11", strategy=strategy,
-                      num_devices=ndev, global_batch=256,
-                      data_dir=os.environ.get("CIFAR_DATA_DIR", "./data"),
-                      log=lambda s: print(s, file=sys.stderr))
-    ips, ips_per_chip = trainer.steady_state_throughput(max_iters=200)
-    print(json.dumps({
-        "metric": "cifar10_vgg11_images_per_sec_per_chip",
-        "value": round(ips_per_chip, 2),
+
+    # Headline: the flagship config on all chips (ddp when the mesh is
+    # non-trivial; Part-1 'single' semantics on one chip).
+    headline_strategy = "ddp" if ndev > 1 else "single"
+    log(f"[bench] headline: {headline_model}/{headline_strategy} "
+        f"on {ndev} device(s)")
+    headline = _throughput(headline_model, headline_strategy, ndev,
+                           global_batch=global_batch, max_iters=2 * max_iters,
+                           data_dir=data_dir, log=lambda s: None)
+
+    result = {
+        "metric": f"cifar10_{headline_model}_images_per_sec_per_chip",
+        "value": round(headline, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(ips_per_chip / TORCH_CPU_BASELINE_IPS, 2),
-    }))
+        "vs_baseline": round(headline / TORCH_CPU_BASELINE_IPS, 2),
+        "num_devices": ndev,
+    }
+
+    if matrix:
+        result["matrix"] = {}
+        for model in models:
+            for strategy in strategies:
+                log(f"[bench] matrix: {model}/{strategy} on {ndev} device(s)")
+                ips = _throughput(model, strategy, ndev,
+                                  global_batch=global_batch,
+                                  max_iters=max_iters, data_dir=data_dir,
+                                  log=lambda s: None)
+                result["matrix"][f"{model}/{strategy}"] = round(ips, 2)
+
+    if sweep:
+        counts = [n for n in (1, 2, 4, 8, 16, 32, 64) if n <= ndev]
+        if counts[-1] != ndev:
+            counts.append(ndev)
+        per_chip = {}
+        for n in counts:
+            strat_n = "ddp" if n > 1 else "single"
+            log(f"[bench] sweep: {headline_model}/{strat_n} on {n} device(s)")
+            per_chip[n] = _throughput(headline_model, strat_n, n,
+                                      global_batch=global_batch,
+                                      max_iters=max_iters, data_dir=data_dir,
+                                      log=lambda s: None)
+        base = per_chip[1]
+        result["scaling"] = {
+            "images_per_sec_per_chip": {str(n): round(v, 2)
+                                        for n, v in per_chip.items()},
+            "efficiency_vs_1chip": {str(n): round(v / base, 3)
+                                    for n, v in per_chip.items()},
+        }
+    return result
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("bench")
+    p.add_argument("--no-matrix", action="store_true",
+                   help="headline metric only (fast driver mode)")
+    p.add_argument("--no-sweep", action="store_true",
+                   help="skip the 1..N-device scaling sweep")
+    p.add_argument("--max-iters", type=int, default=100,
+                   help="steady-state iterations per matrix/sweep config")
+    p.add_argument("--global-batch", type=int, default=256)
+    args = p.parse_args(argv)
+
+    result = run_bench(matrix=not args.no_matrix, sweep=not args.no_sweep,
+                       max_iters=args.max_iters,
+                       global_batch=args.global_batch)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -53,6 +53,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-phases", action="store_true",
                    help="additionally time a forward-only program to report "
                         "the reference's fwd/bwd split")
+    p.add_argument("--limit-train-batches", type=int, default=None,
+                   help="cap train iterations per epoch (smoke runs/benches)")
+    p.add_argument("--limit-eval-batches", type=int, default=None,
+                   help="cap evaluation batches (smoke runs/benches)")
     p.add_argument("--port", type=int, default=6585,
                    help="coordinator port (reference hardcodes 6585)")
     return p
@@ -72,6 +76,8 @@ def main(argv=None) -> None:
         sgd_cfg=sgd.SGDConfig(lr=args.lr, momentum=args.momentum,
                               weight_decay=args.weight_decay),
         profile_phases=args.profile_phases,
+        limit_train_batches=args.limit_train_batches,
+        limit_eval_batches=args.limit_eval_batches,
     )
     trainer.run(args.epochs)
 

@@ -2,6 +2,15 @@
 
 from . import resnet, vgg
 
+# User-registered factories (name -> () -> (init_fn, apply_fn)); lets tests
+# and downstream users plug models into the CLI/bench without editing here.
+_CUSTOM = {}
+
+
+def register_model(name: str, factory) -> None:
+    """Register ``factory() -> (init_fn, apply_fn)`` under ``name``."""
+    _CUSTOM[name.lower()] = factory
+
 
 def get_model(name: str):
     """Return (init_fn, apply_fn) for a model name used by the CLI/bench.
@@ -11,8 +20,11 @@ def get_model(name: str):
     BASELINE.json scaling stress config.
     """
     name = name.lower()
+    if name in _CUSTOM:
+        return _CUSTOM[name]()
     if name in ("vgg11", "vgg13", "vgg16", "vgg19"):
         return vgg.make(name.upper())
     if name in ("resnet18", "resnet-18"):
         return resnet.make()
-    raise ValueError(f"unknown model {name!r}; expected vgg11/13/16/19 or resnet18")
+    raise ValueError(f"unknown model {name!r}; expected vgg11/13/16/19, "
+                     f"resnet18, or one of {sorted(_CUSTOM) or '(none)'}")

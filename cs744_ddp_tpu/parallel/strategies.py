@@ -30,11 +30,17 @@ spectrum (SURVEY.md §2.3):
 
   * ``local``           — reference Part 1: single process, no sync.
 
-XLA note: psums of separate leaves may themselves be combined by the
-compiler's all-reduce combiner; the strategies stay *observably* distinct
-because gather_scatter forces two dependent collectives per leaf and
-bucketed_psum pre-fuses into whole buckets (see tests/test_strategies.py for
-the HLO-level assertions).
+XLA note: the strategies are observably distinct at the StableHLO level
+(34 vs 2 vs 1 collectives for VGG-11; gather_scatter keeps two DEPENDENT
+collectives per leaf — asserted in tests/test_strategies.py).  After XLA
+optimization, the all-reduce combiner merges independent psums — so at the
+COMPILED level even the per-param strategy reaches DDP-grade fusion, with
+bucketed_psum's pre-fusion bounding the combiner's worst case
+(tests/test_tpu_aot.py asserts this on real v5e-8 TPU lowerings).
+Comm/compute overlap on TPU belongs to XLA's latency-hiding scheduler
+(async start/done splits appear where the compiler finds overlap, e.g. the
+gather strategy's all-gather); nothing here hand-schedules what the
+compiler already does.
 """
 
 from __future__ import annotations

@@ -78,6 +78,8 @@ class Trainer:
                  sgd_cfg: sgd.SGDConfig = sgd.SGDConfig(),
                  profile_phases: bool = False,
                  reshuffle_each_epoch: bool = False,
+                 limit_train_batches: Optional[int] = None,
+                 limit_eval_batches: Optional[int] = None,
                  log: Callable[[str], None] = print):
         self.mesh = mesh if mesh is not None else meshlib.make_mesh(num_devices)
         self.world = self.mesh.devices.size
@@ -92,6 +94,10 @@ class Trainer:
         # The reference never reshuffles across epochs (no sampler.set_epoch
         # call — SURVEY.md C6); opt in for proper per-epoch reshuffling.
         self.reshuffle_each_epoch = reshuffle_each_epoch
+        # Optional iteration caps (None = full splits, the reference's
+        # behavior): bound epoch cost for smoke runs and benchmarks.
+        self.limit_train_batches = limit_train_batches
+        self.limit_eval_batches = limit_eval_batches
 
         # Split-replacement generations: staging caches key on these, so
         # swapping a split always restages (id() reuse after GC cannot serve
@@ -218,6 +224,9 @@ class Trainer:
                 reshuffle_each_epoch=self.reshuffle_each_epoch):
             imgs.append(i)
             labs.append(l)
+            if self.limit_train_batches is not None and \
+                    len(imgs) >= self.limit_train_batches:
+                break
         staged = (
             meshlib.put_global(np.stack(imgs), self._epoch_sharding),
             meshlib.put_global(np.stack(labs).astype(np.int32),
@@ -250,6 +259,9 @@ class Trainer:
         for i, l in _eval_batches(self.test_split, self.global_batch):
             imgs.append(i)
             labs.append(l.astype(np.int32))
+            if self.limit_eval_batches is not None and \
+                    len(imgs) >= self.limit_eval_batches:
+                break
         staged = (meshlib.put_global(np.stack(imgs), self._epoch_sharding),
                   meshlib.put_global(np.stack(labs), self._epoch_sharding))
         self._staged_eval = (cache_key, staged)
@@ -295,6 +307,9 @@ class Trainer:
                 self.train_split, self.world, self.global_batch, epoch,
                 shuffle=True, seed=self.seed,
                 reshuffle_each_epoch=self.reshuffle_each_epoch)):
+            if self.limit_train_batches is not None and \
+                    it >= self.limit_train_batches:
+                break
             step_key = jax.random.fold_in(key, it)
             x, y = self._put(imgs, labs)
             t0 = time.time()
@@ -320,6 +335,8 @@ class Trainer:
         images, labels = self._stage_eval()
         loss_sum, corr = self.eval_window(self.state, images, labels)
         n = len(self.test_split.labels)
+        if self.limit_eval_batches is not None:
+            n = min(n, self.limit_eval_batches * self.global_batch)
         # Reference divides the accumulated per-batch mean losses by the
         # number of batches; we accumulate per-example sums, so divide by n
         # (equal when batches are full; exact even on the ragged tail).
@@ -354,12 +371,19 @@ class Trainer:
         nwin = max(2, max_iters // w)
         starts = [i * w for i in range(max(nbatches // w, 1))] or [0]
 
+        # Per-window keys, FOLDED AHEAD OF the timed region: when the start
+        # offsets wrap around a small epoch, the same batches get fresh
+        # augmentation randomness instead of replaying the previous pass's
+        # stream — but a host-side fold_in between dispatches would break
+        # the back-to-back window chain with a tiny interleaved program
+        # (~6% throughput on v5e), so all keys are materialized up front.
+        keys = [jax.device_put(k) for k in
+                jax.random.split(key, nwin + 1)]
+        jax.block_until_ready(keys)
+
         def dispatch(start, wi):
-            # Fold the dispatch counter in: when the start offsets wrap
-            # around a small epoch, the same batches get FRESH augmentation
-            # randomness instead of replaying the previous pass's stream.
             self.state, losses = self.train_window(
-                self.state, jax.random.fold_in(key, wi), epoch_images,
+                self.state, keys[wi], epoch_images,
                 epoch_labels, jnp.int32(start), length_arr)
             return losses
 

@@ -1,0 +1,87 @@
+"""CLI end-to-end + split-phase profiling mode (VERDICT r1 item 7).
+
+The reference's two reporting/launch surfaces that round 1 left untested:
+
+  * the fwd/bwd phase split (``/root/reference/src/Part 1/main.py:28-57``):
+    forward and backward+sync+step timed separately, averaged per
+    20-iteration window, first window excluded;
+  * the argparse CLI (``Part 2a/main.py:156-175``) driving a full
+    train+eval run.
+"""
+
+import numpy as np
+
+from cs744_ddp_tpu import cli
+from cs744_ddp_tpu.data import cifar10
+from cs744_ddp_tpu.train.loop import Trainer
+
+from tinynet import tiny_cnn
+
+
+def test_profile_phases_reports_fwd_bwd_split(tmp_path, mesh4):
+    """profile_phases mode must print Forward/Backward Pass lines from the
+    second window on (warmup window excluded), and run the same number of
+    iterations as the windowed path would."""
+    lines = []
+    tr = Trainer(model=tiny_cnn(), strategy="allreduce", mesh=mesh4,
+                 global_batch=64, data_dir=str(tmp_path), augment=False,
+                 profile_phases=True, log=lines.append)
+    tr.train_split = cifar10.Split(tr.train_split.images[:64 * 45],
+                                   tr.train_split.labels[:64 * 45])
+    timers = tr.train_model(0)
+    text = "\n".join(lines)
+    assert "Training loss after 20 iterations is" in text
+    assert "Training loss after 40 iterations is" in text
+    # Warmup window skipped from the TIMING report (loss still printed).
+    assert "Forward Pass time in iter 20 is" not in text
+    assert "Average Pass time in iter 20 is" not in text
+    # Second window reports all three phase lines.
+    assert "Forward Pass time in iter 40 is" in text
+    assert "Backward Pass time in iter 40 is" in text
+    assert "Average Pass time in iter 40 is" in text
+    # Steady-state samples exist and phases are consistent: fwd <= total.
+    assert len(timers.steady_step_times) == 45 - 20
+    assert len(timers.steady_forward_times) == 45 - 20
+    assert all(f <= s for f, s in zip(timers.steady_forward_times,
+                                      timers.steady_step_times))
+
+
+def test_profile_phases_honors_reshuffle_and_limit(tmp_path, mesh4):
+    """The per-step path must forward reshuffle_each_epoch (ADVICE r1) and
+    respect limit_train_batches."""
+    seen = []
+    tr = Trainer(model=tiny_cnn(), strategy="allreduce", mesh=mesh4,
+                 global_batch=64, data_dir=str(tmp_path), augment=False,
+                 profile_phases=True, reshuffle_each_epoch=True,
+                 limit_train_batches=3, log=seen.append)
+    t0 = tr.train_model(0)
+    t1 = tr.train_model(1)
+    assert t0.iter_number - 1 == 3  # limit respected
+    # Reshuffled epochs see different batches -> different loss sequences.
+    # (Losses also differ because params moved; the REAL reshuffle check is
+    # sharding-level, tests/test_data.py — this pins the flag reaches the
+    # sampler without error.)
+    assert t1.iter_number - 1 == 3
+
+
+def test_cli_end_to_end_smoke(tmp_path, capsys, mesh4):
+    """Drive main([...]) with the reference's knobs end to end on a tiny
+    bounded run: the full print schedule must appear on stdout."""
+    cli.main(["--strategy", "ddp", "--model", "vgg11",
+              "--batch-size", "64", "--num-devices", "4",
+              "--epochs", "1", "--data-dir", str(tmp_path),
+              "--limit-train-batches", "3", "--limit-eval-batches", "2",
+              "--no-augment"])
+    out = capsys.readouterr().out
+    assert "Size of training set is 782" in out
+    assert "Size of test set is" in out
+    assert "Training time after 1 epoch is" in out
+    assert "Test set: Average loss:" in out
+    # Accuracy denominator reflects the eval cap (2 batches x 64).
+    assert "/128 (" in out
+
+
+def test_cli_rejects_unknown_strategy(tmp_path):
+    import pytest
+    with pytest.raises(SystemExit):
+        cli.main(["--strategy", "zero_redundancy"])

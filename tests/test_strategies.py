@@ -116,6 +116,79 @@ def test_ddp_vs_allreduce_collective_counts(mesh8):
     assert len(re.findall(r"stablehlo\.all_reduce", hlo)) == 4
 
 
+def test_compiled_step_reaches_ddp_grade_fusion(mesh8):
+    """At the COMPILED level (post-XLA-optimization), the whole train step
+    must carry at most bucket-count all-reduces for BOTH the ddp and the
+    per-param strategy: XLA's all-reduce combiner delivers DDP-grade fusion
+    — the capability torch gets from DDP's C++ reducer — with the bucketed
+    pre-fusion bounding the worst case.  (The strategies stay observably
+    distinct pre-optimization; see test_ddp_vs_allreduce_collective_counts.)
+    """
+    from tinynet import tiny_cnn
+
+    import jax.numpy as jnp
+    from cs744_ddp_tpu.ops import sgd
+    from cs744_ddp_tpu.train import step as steplib
+
+    init_fn, apply_fn = tiny_cnn()
+    state = steplib.init_train_state(init_fn, jax.random.PRNGKey(0))
+    imgs = jnp.zeros((64, 32, 32, 3), jnp.uint8)
+    labs = jnp.zeros((64,), jnp.int32)
+    for name in ("allreduce", "ddp"):
+        step = steplib.make_train_step(
+            apply_fn, strategies.get_strategy(name), mesh8, sgd.SGDConfig(),
+            augment=False)
+        txt = step.lower(state, jax.random.PRNGKey(0), imgs, labs) \
+                  .compile().as_text()
+        n = len(re.findall(r" all-reduce\(", txt))
+        assert 1 <= n <= 2, (name, n)  # 4 grad leaves -> <= 2 collectives
+
+
+def test_ddp_wallclock_not_slower_than_allreduce(mesh8):
+    """Part 3's capability claim, measured: the bucketed-fused tier must not
+    lose to per-param all-reduce on a model with many parameter leaves
+    (ResNet-18, ~60 leaves).  On this XLA version both compile to the same
+    fused collective schedule, so this pins 'ddp >= allreduce' as a
+    wall-clock invariant (margin covers CI timer noise)."""
+    import time
+
+    import jax.numpy as jnp
+    from cs744_ddp_tpu.models import resnet
+    from cs744_ddp_tpu.ops import sgd
+    from cs744_ddp_tpu.train import step as steplib
+
+    init_fn, apply_fn = resnet.ResNet18()
+    state = steplib.init_train_state(init_fn, jax.random.PRNGKey(0))
+    imgs = jnp.zeros((32, 32, 32, 3), jnp.uint8)
+    labs = jnp.zeros((32,), jnp.int32)
+
+    # Compile and warm BOTH programs first, then INTERLEAVE the timed steps:
+    # back-to-back A/B pairs cancel the load drift of a shared CI host that
+    # sequential per-strategy timing is exposed to.
+    steps, states = {}, {}
+    for name in ("allreduce", "ddp"):
+        step = steplib.make_train_step(
+            apply_fn, strategies.get_strategy(name), mesh8, sgd.SGDConfig(),
+            augment=False)
+        s = state
+        for i in range(2):
+            s, loss = step(s, jax.random.PRNGKey(i), imgs, labs)
+            jax.block_until_ready(loss)
+        steps[name], states[name] = step, s
+
+    times = {"allreduce": [], "ddp": []}
+    for i in range(5):
+        for name in ("allreduce", "ddp"):
+            t0 = time.time()
+            states[name], loss = steps[name](
+                states[name], jax.random.PRNGKey(i), imgs, labs)
+            jax.block_until_ready(loss)
+            times[name].append(time.time() - t0)
+
+    med = {k: sorted(v)[len(v) // 2] for k, v in times.items()}
+    assert med["ddp"] <= med["allreduce"] * 1.5, med
+
+
 def test_strategy_registry():
     assert set(strategies.STRATEGIES) == {"single", "gather", "allreduce",
                                           "ddp"}

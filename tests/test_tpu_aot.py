@@ -1,0 +1,90 @@
+"""Multi-chip TPU compilation, without TPU hardware: AOT compile-only.
+
+``jax.experimental.topologies`` provides a deviceless v5e-8 topology, so CI
+can compile the REAL 8-chip TPU programs (the thing the virtual CPU mesh
+cannot check: TPU lowering, ICI collective selection, the compiled
+collective schedule) and assert structure on the final HLO.
+
+Notes on what TPU HLO shows (vs the GPU backend): XLA:GPU splits async
+collectives into ``all-reduce-start/done`` pairs in the final module; the
+TPU backend schedules collectives internally and typically keeps a fused
+sync ``all-reduce`` op at this model scale, while splitting collectives it
+chooses to overlap (the gather strategy's ``all-gather`` does appear as an
+async start/done pair).  Overlap on TPU is the latency-hiding scheduler's
+job — the bucketed pre-fusion bounds the combiner's worst case, it does not
+hand-schedule.
+"""
+
+import re
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cs744_ddp_tpu.models import vgg
+from cs744_ddp_tpu.ops import sgd
+from cs744_ddp_tpu.parallel import get_strategy
+from cs744_ddp_tpu.parallel.mesh import DATA_AXIS
+from cs744_ddp_tpu.train import step as steplib
+
+from tinynet import tiny_cnn
+
+
+@pytest.fixture(scope="module")
+def v5e8_mesh():
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc("v5e:2x4", platform="tpu")
+    except Exception as e:  # no TPU compile-only client in this env
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    return Mesh(np.array(topo.devices), (DATA_AXIS,))
+
+
+def _compile_step(mesh, model, strategy, batch):
+    init_fn, apply_fn = model
+    state = steplib.init_train_state(init_fn, jax.random.PRNGKey(0))
+    rep = NamedSharding(mesh, P())
+    sharded = NamedSharding(mesh, P(DATA_AXIS))
+    state_sds = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep), state)
+    args = (state_sds,
+            jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep),
+            jax.ShapeDtypeStruct((batch, 32, 32, 3), jnp.uint8,
+                                 sharding=sharded),
+            jax.ShapeDtypeStruct((batch,), jnp.int32, sharding=sharded))
+    step = steplib.make_train_step(apply_fn, get_strategy(strategy), mesh,
+                                   sgd.SGDConfig(), augment=True)
+    return step.lower(*args).compile().as_text()
+
+
+def test_vgg11_ddp_compiles_for_v5e8_and_fuses(v5e8_mesh):
+    """The flagship config (VGG-11, ddp) must compile for 8 real-topology
+    v5e chips, and the compiled program must carry at most bucket-count
+    (37 MB grads / 25 MB = 2) all-reduces — DDP-grade fusion on TPU."""
+    txt = _compile_step(v5e8_mesh, vgg.VGG11(), "ddp", 256)
+    n = len(re.findall(r" all-reduce\(", txt))
+    assert 1 <= n <= 2, n
+
+
+def test_vgg11_allreduce_combiner_matches_ddp_grade(v5e8_mesh):
+    """Even the deliberately-unfused per-param strategy (34 psums in
+    StableHLO) must come out of the TPU combiner at <= bucket count: the
+    compiler supplies the fusion torch needs DDP's C++ reducer for."""
+    txt = _compile_step(v5e8_mesh, vgg.VGG11(), "allreduce", 256)
+    n = len(re.findall(r" all-reduce\(", txt))
+    assert 1 <= n <= 2, n
+
+
+def test_gather_strategy_keeps_two_phase_shape_on_tpu(v5e8_mesh):
+    """Part 2a's deliberately-naive root-mediated pattern must SURVIVE TPU
+    compilation as two dependent collective phases (gather, then
+    mean-broadcast) — and the all-gather phase is scheduled async
+    (start/done split), evidence XLA overlaps collectives it can."""
+    txt = _compile_step(v5e8_mesh, tiny_cnn(), "gather", 64)
+    assert len(re.findall(r"all-gather", txt)) >= 1
+    assert len(re.findall(r"all-gather-start", txt)) >= 1  # async split
+    assert len(re.findall(r" all-reduce\(", txt)) >= 1     # broadcast phase
