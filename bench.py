@@ -93,6 +93,12 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         per_chip = {}
         for n in counts:
             strat_n = "ddp" if n > 1 else "single"
+            # The all-chip ddp point is the matrix's headline entry — reuse
+            # it instead of restaging + recompiling the identical config.
+            cached = result.get("matrix", {}).get(f"{headline_model}/{strat_n}")
+            if n == ndev and cached is not None:
+                per_chip[n] = cached
+                continue
             log(f"[bench] sweep: {headline_model}/{strat_n} on {n} device(s)")
             per_chip[n] = _throughput(headline_model, strat_n, n,
                                       global_batch=global_batch,

@@ -1,12 +1,15 @@
-"""Gradient bucketing: flatten parameter-gradient leaves into size-bounded
-1-D fusion buckets.
+"""Gradient bucketing: group parameter-gradient leaves into size-bounded
+fusion buckets.
 
 This is the TPU-native analogue of torch DDP's C++ reducer bucketing
 (reference: ``DDP(model)`` at ``/root/reference/src/Part 3/main.py:61``; the
 reducer groups gradients into ~25 MB buckets and all-reduces each bucket as
-one flat tensor).  Here the plan is computed once from the parameter pytree's
-shapes (host side), and flatten/unflatten are pure jittable reshape/concat
-ops, so each bucket becomes exactly one fused XLA AllReduce.
+one flat tensor).  torch flattens buckets into contiguous buffers because
+NCCL wants one launch over one buffer; XLA's fused collective is the
+*variadic* all-reduce, so here a bucket is just a leaf grouping — the plan
+is computed once from the pytree's shapes (host side) and each bucket
+becomes one multi-operand ``lax.psum`` (strategies.bucketed_psum), one
+fused XLA AllReduce with no flatten/unflatten copies.
 
 Like DDP, leaves are bucketed in *reverse* registration order (gradients
 become ready last-layer-first during backward).
@@ -14,10 +17,9 @@ become ready last-layer-first during backward).
 
 from __future__ import annotations
 
-from typing import Any, List, NamedTuple, Sequence, Tuple
+from typing import Any, List, NamedTuple, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 DEFAULT_BUCKET_BYTES = 25 * 2 ** 20  # torch DDP default bucket_cap_mb=25
@@ -25,9 +27,6 @@ DEFAULT_BUCKET_BYTES = 25 * 2 ** 20  # torch DDP default bucket_cap_mb=25
 
 class BucketPlan(NamedTuple):
     treedef: Any
-    shapes: Tuple[Tuple[int, ...], ...]     # per leaf, original order
-    sizes: Tuple[int, ...]                  # per leaf element counts
-    order: Tuple[int, ...]                  # leaf index -> position in bucket walk
     buckets: Tuple[Tuple[int, ...], ...]    # each bucket: leaf indices (orig order ids)
 
     @property
@@ -38,10 +37,8 @@ class BucketPlan(NamedTuple):
 def make_plan(params_like: Any,
               bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> BucketPlan:
     leaves, treedef = jax.tree.flatten(params_like)
-    shapes = tuple(tuple(l.shape) for l in leaves)
-    sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
-    nbytes = [sizes[i] * jnp.asarray(leaves[i]).dtype.itemsize
-              for i in range(len(leaves))]
+    nbytes = [int(np.prod(l.shape) if l.shape else 1)
+              * np.dtype(l.dtype).itemsize for l in leaves]
 
     buckets: List[List[int]] = []
     cur: List[int] = []
@@ -55,30 +52,5 @@ def make_plan(params_like: Any,
     if cur:
         buckets.append(cur)
 
-    order = tuple(i for b in buckets for i in b)
-    return BucketPlan(treedef=treedef, shapes=shapes, sizes=sizes,
-                      order=order, buckets=tuple(tuple(b) for b in buckets))
-
-
-def flatten_to_buckets(grads: Any, plan: BucketPlan) -> List[jax.Array]:
-    """Pytree -> list of 1-D bucket arrays (pure reshapes + concats)."""
-    leaves = jax.tree.leaves(grads)
-    out = []
-    for bucket in plan.buckets:
-        flat = [leaves[i].reshape(-1) for i in bucket]
-        out.append(flat[0] if len(flat) == 1 else jnp.concatenate(flat))
-    return out
-
-
-def unflatten_from_buckets(buckets: Sequence[jax.Array],
-                           plan: BucketPlan) -> Any:
-    """Inverse of flatten_to_buckets."""
-    leaves: List[Any] = [None] * len(plan.shapes)
-    for bucket_ids, flat in zip(plan.buckets, buckets):
-        off = 0
-        for i in bucket_ids:
-            n = plan.sizes[i]
-            leaves[i] = jax.lax.slice(flat, (off,), (off + n,)).reshape(
-                plan.shapes[i])
-            off += n
-    return jax.tree.unflatten(plan.treedef, leaves)
+    return BucketPlan(treedef=treedef,
+                      buckets=tuple(tuple(b) for b in buckets))

@@ -7,55 +7,72 @@ spectrum (SURVEY.md §2.3):
 
   * ``gather_scatter``  — reference Part 2a (``main.py:117-127``):
     per parameter, rank 0 gathers every worker's grad, means them, scatters
-    the average back.  Here: per leaf, ``all_gather`` (a superset of
-    gather-to-root on ICI), then the gathered stack is zeroed on every mesh
-    position except 0 *before* the mean — so the only mean value that
-    reaches the result is the one computed at the root (non-root positions
-    reduce zeros) — and the root's mean is broadcast via ``psum``.  Two
-    sequential collectives per leaf with root-located compute, preserving
-    the deliberately-naive communication shape for honest benchmarking.
-    (SPMD executes the same program text everywhere; "root-located" means
-    the root's arithmetic is the only contribution to the output, exactly
-    as rank 0's ``torch.mean`` is in the reference.)
+    the average back — one blocking gather + one blocking scatter per leaf,
+    sequentially.  Here: per leaf, ``all_gather`` (a superset of
+    gather-to-root on ICI), the gathered stack zeroed on every mesh position
+    except 0 *before* the mean (root-located compute, like rank 0's
+    ``torch.mean``), then the root's mean broadcast via ``psum``; leaves are
+    chained through ``optimization_barrier`` so the per-leaf collective
+    pairs stay *sequential* in the compiled TPU program, preserving the
+    deliberately-naive blocking-loop cost model for honest benchmarking.
 
   * ``per_param_psum``  — reference Part 2b (``main.py:116-119``):
-    one all-reduce per parameter leaf, then divide by world size.  Here: one
-    ``lax.psum`` per leaf (34 collectives for VGG-11+BN), no fusion.
+    one blocking all-reduce per parameter leaf, sequentially, no fusion.
+    Here: one ``lax.psum`` per leaf (34 collectives for VGG-11+BN), chained
+    through ``optimization_barrier`` — without the chain XLA's all-reduce
+    combiner would quietly rewrite this tier into the fused one, erasing
+    the Part-2b/Part-3 cost distinction the reference exists to measure.
 
   * ``bucketed_psum``   — reference Part 3 (``DDP(model)``, ``main.py:61``):
-    DDP's bucketed fused reducer.  Here: leaves are flattened into ≤25 MB
-    buckets (reverse registration order, like DDP) and each bucket is one
-    fused ``psum``; XLA schedules the collectives asynchronously, giving the
-    comm/compute overlap DDP gets from backward hooks.
+    DDP's bucketed fused reducer.  torch materialises ~25 MB flat buffers
+    because NCCL wants one contiguous launch; XLA's native fused form is
+    the *variadic* all-reduce (exactly what its all-reduce combiner
+    produces), so the TPU-native bucket is one multi-operand ``lax.psum``
+    over the bucket's leaves — one fused collective per bucket with ZERO
+    copy overhead (no flatten/concat/slice round-trip through HBM).
+    Buckets are formed in reverse registration order (grads become ready
+    last-layer-first) and chained bucket-to-bucket, mirroring DDP's single
+    in-order comm stream; comm/compute overlap within the step belongs to
+    XLA's latency-hiding scheduler.
 
   * ``local``           — reference Part 1: single process, no sync.
 
-XLA note: the strategies are observably distinct at the StableHLO level
-(34 vs 2 vs 1 collectives for VGG-11; gather_scatter keeps two DEPENDENT
-collectives per leaf — asserted in tests/test_strategies.py).  After XLA
-optimization, the all-reduce combiner merges independent psums — so at the
-COMPILED level even the per-param strategy reaches DDP-grade fusion, with
-bucketed_psum's pre-fusion bounding the combiner's worst case
-(tests/test_tpu_aot.py asserts this on real v5e-8 TPU lowerings).
-Comm/compute overlap on TPU belongs to XLA's latency-hiding scheduler
-(async start/done splits appear where the compiler finds overlap, e.g. the
-gather strategy's all-gather); nothing here hand-schedules what the
-compiler already does.
+XLA note: the barrier chains are what keep the tiers *observably distinct
+in the compiled TPU program* (SURVEY.md §7 "hard parts"): on the v5e-8
+lowering, ``allreduce`` compiles to one all-reduce per leaf while ``ddp``
+compiles to bucket-count fused all-reduces (asserted in
+tests/test_tpu_aot.py).  The CPU backend used by the unit tests strips
+optimization barriers and combines everything — there the tiers are
+asserted distinct at the StableHLO level instead
+(tests/test_strategies.py), and their wall-clock converges, which is also
+asserted: the fused tier must never LOSE to the per-param tier.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Optional
+from typing import Any, Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .bucketing import BucketPlan, DEFAULT_BUCKET_BYTES, flatten_to_buckets, \
-    make_plan, unflatten_from_buckets
+from .bucketing import BucketPlan, DEFAULT_BUCKET_BYTES, make_plan
 
 Strategy = Callable[[Any, str], Any]
+
+
+def _after(x, dep):
+    """Order ``x``'s consumers after ``dep`` (sequential-collective chains).
+
+    ``optimization_barrier`` makes ``x`` data-depend on ``dep``, so the
+    collective fed by ``x`` cannot start — nor be combiner-merged — before
+    the collective that produced ``dep`` completes, reproducing the
+    reference's blocking per-parameter loops in compiled form."""
+    if dep is None:
+        return x
+    x, _ = lax.optimization_barrier((x, dep))
+    return x
 
 
 def local(grads: Any, axis_name: str) -> Any:
@@ -65,37 +82,65 @@ def local(grads: Any, axis_name: str) -> Any:
 
 
 def per_param_psum(grads: Any, axis_name: str) -> Any:
-    """One all-reduce per leaf; sum then divide by world (Part 2b parity)."""
+    """One all-reduce per leaf, sequentially; sum / world (Part 2b parity)."""
     world = lax.axis_size(axis_name)
-    return jax.tree.map(lambda g: lax.psum(g, axis_name) / world, grads)
+    leaves, treedef = jax.tree.flatten(grads)
+    out: List[Any] = []
+    prev = None
+    for g in leaves:
+        s = lax.psum(_after(g, prev), axis_name)
+        out.append(s / world)
+        prev = s
+    return jax.tree.unflatten(treedef, out)
 
 
 def gather_scatter(grads: Any, axis_name: str) -> Any:
     """Root-mediated gather -> mean-on-root -> broadcast (Part 2a parity)."""
     idx = lax.axis_index(axis_name)
-
-    def leaf(g):
-        gathered = lax.all_gather(g, axis_name)          # collective 1 (gather)
+    leaves, treedef = jax.tree.flatten(grads)
+    out: List[Any] = []
+    prev = None
+    for g in leaves:
+        gathered = lax.all_gather(_after(g, prev), axis_name)  # collective 1
         # Mask BEFORE the mean: non-root positions reduce zeros, so the
         # mean that survives the psum is computed at mesh position 0 only —
         # root-located compute, like rank 0's torch.mean in the reference.
         rooted = jnp.where(idx == 0, gathered, jnp.zeros_like(gathered))
         mean = jnp.mean(rooted, axis=0)
-        return lax.psum(mean, axis_name)                 # collective 2 (scatter/bcast)
-
-    return jax.tree.map(leaf, grads)
+        s = lax.psum(mean, axis_name)                          # collective 2
+        out.append(s)
+        prev = s
+    return jax.tree.unflatten(treedef, out)
 
 
 def bucketed_psum(grads: Any, axis_name: str, *,
                   plan: Optional[BucketPlan] = None,
                   bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> Any:
-    """Bucketed fused all-reduce — the DDP-equivalent performance tier."""
+    """Bucketed fused all-reduce — the DDP-equivalent performance tier.
+
+    One variadic ``psum`` per bucket: XLA lowers the multi-operand reduce
+    to a single fused all-reduce (its combiner's own canonical form), so
+    each bucket costs exactly one collective and no data movement beyond
+    the wire transfer itself."""
     if plan is None:
         plan = make_plan(grads, bucket_bytes)
     world = lax.axis_size(axis_name)
-    buckets = flatten_to_buckets(grads, plan)
-    reduced = [lax.psum(b, axis_name) / world for b in buckets]
-    return unflatten_from_buckets(reduced, plan)
+    leaves = jax.tree.leaves(grads)
+    out: List[Any] = [None] * len(leaves)
+    prev = ()
+    for bucket in plan.buckets:
+        gs = tuple(leaves[i] for i in bucket)
+        if prev:
+            # Chain on the WHOLE previous bucket: every one of this
+            # bucket's reduces must follow every one of the previous
+            # bucket's, or the combiner could legally merge collectives
+            # across the bucket boundary.
+            gs = lax.optimization_barrier(gs + prev)[:len(gs)]
+        reduced = lax.psum(gs, axis_name)
+        for i, r in zip(bucket, reduced):
+            out[i] = r / world
+        prev = tuple(reduced)
+    return jax.tree.unflatten(plan.treedef, out)
 
 
 STRATEGIES = {

@@ -96,6 +96,10 @@ class Trainer:
         self.reshuffle_each_epoch = reshuffle_each_epoch
         # Optional iteration caps (None = full splits, the reference's
         # behavior): bound epoch cost for smoke runs and benchmarks.
+        for name, lim in (("limit_train_batches", limit_train_batches),
+                          ("limit_eval_batches", limit_eval_batches)):
+            if lim is not None and lim < 1:
+                raise ValueError(f"{name} must be >= 1, got {lim}")
         self.limit_train_batches = limit_train_batches
         self.limit_eval_batches = limit_eval_batches
 

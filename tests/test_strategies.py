@@ -65,18 +65,13 @@ def test_local_strategy_is_identity():
         np.asarray(a), np.asarray(b)), out, grads)
 
 
-def test_bucketing_roundtrip_exact():
+def test_bucketing_plan_partitions_all_leaves():
     grads = tree_of_grads(jax.random.PRNGKey(3))
+    n_leaves = len(jax.tree.leaves(grads))
     for bucket_bytes in (64, 4096, bucketing.DEFAULT_BUCKET_BYTES):
         plan = bucketing.make_plan(grads, bucket_bytes)
-        flat = bucketing.flatten_to_buckets(grads, plan)
-        assert all(f.ndim == 1 for f in flat)
-        back = bucketing.unflatten_from_buckets(flat, plan)
-        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
-            np.asarray(a), np.asarray(b)), grads, back)
-        total = sum(int(f.size) for f in flat)
-        assert total == sum(int(np.prod(l.shape))
-                            for l in jax.tree.leaves(grads))
+        covered = sorted(i for b in plan.buckets for i in b)
+        assert covered == list(range(n_leaves))  # exact partition
 
 
 def test_bucketing_respects_size_bound_and_reverse_order():
@@ -89,41 +84,55 @@ def test_bucketing_respects_size_bound_and_reverse_order():
     assert plan.buckets[0] == (2,)
 
 
-def test_ddp_vs_allreduce_collective_counts(mesh8):
-    """The DDP strategy must emit FEWER all-reduces than per-param: buckets,
-    not leaves — the observable difference between Part 2b and Part 3."""
+def test_strategy_collective_patterns_in_stablehlo(mesh8):
+    """The tiers must stay observably distinct pre-optimization: the
+    per-param tier is a barrier-CHAINED sequence of per-leaf all-reduces
+    (Part 2b's blocking loop — leaves-1 barriers), while the ddp tier
+    groups leaves into buckets with barriers only BETWEEN buckets
+    (Part 3's in-order comm stream).  The compiled-level distinctness (one
+    collective per leaf vs per bucket on the v5e-8 lowering) is asserted
+    in tests/test_tpu_aot.py — the CPU backend here strips barriers and
+    fuses both tiers (test_ddp_wallclock_not_slower_than_allreduce pins
+    that convergence)."""
     grads = tree_of_grads(jax.random.PRNGKey(1))
     stacked = jax.tree.map(lambda a: a[None].repeat(8, 0), grads)
 
-    def count_all_reduce(strategy):
+    def counts(strategy):
         f = shard_map(lambda g: strategy(
             jax.tree.map(lambda a: a[0], g), DATA_AXIS),
             mesh=mesh8, in_specs=(P(DATA_AXIS),), out_specs=P())
         hlo = jax.jit(f).lower(stacked).as_text()  # StableHLO MLIR
-        return len(re.findall(r"stablehlo\.all_reduce", hlo))
+        return (len(re.findall(r"stablehlo\.all_reduce", hlo)),
+                len(re.findall(r"stablehlo\.optimization_barrier", hlo)))
 
-    n_allreduce = count_all_reduce(strategies.get_strategy("allreduce"))
-    n_ddp = count_all_reduce(strategies.get_strategy("ddp"))
-    assert n_allreduce == 4          # one per leaf
-    assert n_ddp == 1                # all four leaves fit one 25MB bucket
+    n_ar, n_bar = counts(strategies.get_strategy("allreduce"))
+    assert (n_ar, n_bar) == (4, 3)   # per leaf, sequentially chained
 
-    # gather_scatter lowers to all-gather + all-reduce per leaf.
+    n_ar, n_bar = counts(strategies.get_strategy("ddp"))
+    assert (n_ar, n_bar) == (4, 0)   # all four leaves fit one 25MB bucket
+
+    # Tiny buckets: one leaf per bucket -> chained like DDP's comm stream.
+    n_ar, n_bar = counts(strategies.get_strategy("ddp", bucket_bytes=64))
+    assert (n_ar, n_bar) == (4, 3)
+
+    # gather_scatter: all-gather + all-reduce per leaf, chained.
     f = shard_map(lambda g: strategies.gather_scatter(
         jax.tree.map(lambda a: a[0], g), DATA_AXIS),
         mesh=mesh8, in_specs=(P(DATA_AXIS),), out_specs=P())
     hlo = jax.jit(f).lower(stacked).as_text()
     assert len(re.findall(r"stablehlo\.all_gather", hlo)) == 4
     assert len(re.findall(r"stablehlo\.all_reduce", hlo)) == 4
+    assert len(re.findall(r"stablehlo\.optimization_barrier", hlo)) == 3
 
 
 def test_compiled_step_reaches_ddp_grade_fusion(mesh8):
-    """At the COMPILED level (post-XLA-optimization), the whole train step
-    must carry at most bucket-count all-reduces for BOTH the ddp and the
-    per-param strategy: XLA's all-reduce combiner delivers DDP-grade fusion
-    — the capability torch gets from DDP's C++ reducer — with the bucketed
-    pre-fusion bounding the worst case.  (The strategies stay observably
-    distinct pre-optimization; see test_ddp_vs_allreduce_collective_counts.)
-    """
+    """On the CPU BACKEND (which strips optimization barriers), the whole
+    compiled train step must carry at most bucket-count all-reduces for
+    BOTH the ddp and the per-param strategy: XLA's all-reduce combiner
+    delivers DDP-grade fusion — the capability torch gets from DDP's C++
+    reducer.  On TPU the barrier chains keep the tiers distinct instead
+    (tests/test_tpu_aot.py); pre-optimization structure is pinned in
+    test_strategy_collective_patterns_in_stablehlo."""
     from tinynet import tiny_cnn
 
     import jax.numpy as jnp
@@ -177,7 +186,7 @@ def test_ddp_wallclock_not_slower_than_allreduce(mesh8):
         steps[name], states[name] = step, s
 
     times = {"allreduce": [], "ddp": []}
-    for i in range(5):
+    for i in range(9):
         for name in ("allreduce", "ddp"):
             t0 = time.time()
             states[name], loss = steps[name](
@@ -185,6 +194,8 @@ def test_ddp_wallclock_not_slower_than_allreduce(mesh8):
             jax.block_until_ready(loss)
             times[name].append(time.time() - t0)
 
+    # Median over 9 interleaved pairs: robust to per-step scheduler spikes
+    # (a single outlier cannot move the median) as well as slow drift.
     med = {k: sorted(v)[len(v) // 2] for k, v in times.items()}
     assert med["ddp"] <= med["allreduce"] * 1.5, med
 

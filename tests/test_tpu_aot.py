@@ -11,8 +11,13 @@ TPU backend schedules collectives internally and typically keeps a fused
 sync ``all-reduce`` op at this model scale, while splitting collectives it
 chooses to overlap (the gather strategy's ``all-gather`` does appear as an
 async start/done pair).  Overlap on TPU is the latency-hiding scheduler's
-job — the bucketed pre-fusion bounds the combiner's worst case, it does not
-hand-schedule.
+job.
+
+These tests pin the COMPILED cost spectrum — the reference's pedagogical
+point, which survives TPU compilation because the strategies' barrier
+chains prevent the all-reduce combiner from equalizing the tiers
+(strategies.py): per-param stays one collective per leaf, ddp collapses to
+one fused variadic collective per ~25 MB bucket.
 """
 
 import re
@@ -63,20 +68,27 @@ def _compile_step(mesh, model, strategy, batch):
 
 def test_vgg11_ddp_compiles_for_v5e8_and_fuses(v5e8_mesh):
     """The flagship config (VGG-11, ddp) must compile for 8 real-topology
-    v5e chips, and the compiled program must carry at most bucket-count
-    (37 MB grads / 25 MB = 2) all-reduces — DDP-grade fusion on TPU."""
+    v5e chips, and the compiled program must carry about bucket-count
+    (37 MB grads / 25 MB = 2) all-reduces — DDP-grade fusion on TPU (+1
+    margin for the step's own scalar-metric psum)."""
     txt = _compile_step(v5e8_mesh, vgg.VGG11(), "ddp", 256)
     n = len(re.findall(r" all-reduce\(", txt))
-    assert 1 <= n <= 2, n
+    assert 1 <= n <= 3, n
 
 
-def test_vgg11_allreduce_combiner_matches_ddp_grade(v5e8_mesh):
-    """Even the deliberately-unfused per-param strategy (34 psums in
-    StableHLO) must come out of the TPU combiner at <= bucket count: the
-    compiler supplies the fusion torch needs DDP's C++ reducer for."""
+def test_vgg11_allreduce_keeps_per_leaf_collectives_on_tpu(v5e8_mesh):
+    """Part 2b's deliberately-unfused cost model must SURVIVE TPU
+    compilation: the barrier-chained per-param tier keeps (at least) one
+    all-reduce per parameter leaf (34 for VGG-11+BN) — without the chain
+    XLA's combiner would rewrite it into the ddp tier and erase the cost
+    spectrum the reference exists to measure."""
     txt = _compile_step(v5e8_mesh, vgg.VGG11(), "allreduce", 256)
     n = len(re.findall(r" all-reduce\(", txt))
-    assert 1 <= n <= 2, n
+    assert n >= 34, n
+
+    # And the spectrum is ordered: ddp strictly fewer collectives.
+    txt_ddp = _compile_step(v5e8_mesh, vgg.VGG11(), "ddp", 256)
+    assert len(re.findall(r" all-reduce\(", txt_ddp)) < n
 
 
 def test_gather_strategy_keeps_two_phase_shape_on_tpu(v5e8_mesh):
