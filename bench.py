@@ -93,9 +93,13 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         per_chip = {}
         for n in counts:
             strat_n = "ddp" if n > 1 else "single"
-            # The all-chip ddp point is the matrix's headline entry — reuse
-            # it instead of restaging + recompiling the identical config.
+            # The all-chip point duplicates a config already measured (the
+            # matrix's ddp entry on multi-chip hosts; the headline itself —
+            # same strategy, 2x the iterations — on a 1-chip host): reuse
+            # instead of restaging + recompiling the identical config.
             cached = result.get("matrix", {}).get(f"{headline_model}/{strat_n}")
+            if n == ndev and strat_n == headline_strategy:
+                cached = headline
             if n == ndev and cached is not None:
                 per_chip[n] = cached
                 continue

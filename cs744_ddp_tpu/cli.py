@@ -50,6 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", type=float, default=1e-4)
     p.add_argument("--no-augment", action="store_true")
+    p.add_argument("--precision", default="f32", choices=["f32", "bf16"],
+                   help="compute precision: f32 = reference parity; bf16 = "
+                        "mixed precision (f32 master weights/optimizer/BN "
+                        "stats/loss, bf16 matmul+conv — the MXU native mode)")
     p.add_argument("--profile-phases", action="store_true",
                    help="additionally time a forward-only program to report "
                         "the reference's fwd/bwd split")
@@ -73,6 +77,7 @@ def main(argv=None) -> None:
         global_batch=args.batch_size,
         data_dir=args.data_dir,
         augment=not args.no_augment,
+        precision=args.precision,
         sgd_cfg=sgd.SGDConfig(lr=args.lr, momentum=args.momentum,
                               weight_decay=args.weight_decay),
         profile_phases=args.profile_phases,

@@ -63,15 +63,20 @@ def conv2d_init(key: jax.Array, in_ch: int, out_ch: int, ksize: int = 3,
 
 def conv2d_apply(params: Params, x: jax.Array, stride: int = 1,
                  padding: int = 1) -> jax.Array:
-    """x: [N,H,W,C] -> [N,H',W',out_ch]."""
+    """x: [N,H,W,C] -> [N,H',W',out_ch].
+
+    Compute dtype follows the ACTIVATION: master weights stay f32 and are
+    cast to x.dtype here (a no-op for f32 x), so feeding bf16 activations
+    runs the conv natively on the MXU (bf16 multiply, f32 accumulate)
+    without a separate low-precision parameter copy."""
     y = lax.conv_general_dilated(
-        x, params["w"],
+        x, params["w"].astype(x.dtype),
         window_strides=(stride, stride),
         padding=[(padding, padding), (padding, padding)],
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
     if "b" in params:
-        y = y + params["b"]
+        y = y + params["b"].astype(y.dtype)
     return y
 
 
@@ -91,7 +96,8 @@ def linear_init(key: jax.Array, in_features: int, out_features: int,
 
 
 def linear_apply(params: Params, x: jax.Array) -> jax.Array:
-    return x @ params["w"] + params["b"]
+    # Master weights f32, compute in the activation dtype (see conv2d_apply).
+    return x @ params["w"].astype(x.dtype) + params["b"].astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -110,6 +116,59 @@ def batchnorm_init(num_features: int, dtype=jnp.float32) -> Tuple[Params, State]
     return params, state
 
 
+@jax.custom_vjp
+def _bn_train_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array):
+    """(y, mean, biased_var) with the classic fused BN backward.
+
+    Forward computes CENTERED two-pass statistics in f32 (the one-pass
+    E[x^2]-E[x]^2 form cancels catastrophically for large mean/std ratios
+    — and torch's BatchNorm2d is centered, so parity demands it); backward
+    uses the closed-form BN gradient (two fused passes over the activation)
+    instead of letting autodiff differentiate through the statistics chain,
+    which materializes several extra activation-sized intermediates — BN is
+    HBM-bandwidth-bound, so passes are the cost that matters on TPU.
+
+    The mean/var outputs feed only the (non-differentiated) running-stats
+    update — torch likewise treats running stats as statistics, outside the
+    autograd graph — so their cotangents are structurally zero and the
+    backward ignores them.
+    """
+    y, _, mean, var, _ = _bn_train_fwd_impl(x, gamma, beta)
+    return y, mean, var
+
+
+def _bn_train_fwd_impl(x, gamma, beta):
+    xf = x.astype(jnp.float32)
+    axes = (0, 1, 2)
+    mean = jnp.mean(xf, axes)
+    var = jnp.mean(jnp.square(xf - mean), axes)  # biased, centered
+    inv = lax.rsqrt(var + BN_EPS)
+    xhat = (xf - mean) * inv
+    y = (xhat * gamma + beta).astype(x.dtype)
+    return y, xhat, mean, var, inv
+
+
+def _bn_train_fwd(x, gamma, beta):
+    y, xhat, mean, var, inv = _bn_train_fwd_impl(x, gamma, beta)
+    # Zero-sized array carries x's dtype (raw dtypes aren't valid residuals).
+    return (y, mean, var), (xhat, inv, gamma, jnp.zeros((0,), x.dtype))
+
+
+def _bn_train_bwd(res, cts):
+    xhat, inv, gamma, dtype_token = res
+    in_dtype = dtype_token.dtype
+    dy = cts[0].astype(jnp.float32)  # ct_mean/ct_var structurally zero
+    axes = (0, 1, 2)
+    n = xhat.shape[0] * xhat.shape[1] * xhat.shape[2]
+    sum_dy = jnp.sum(dy, axes)
+    sum_dy_xhat = jnp.sum(dy * xhat, axes)
+    dx = (gamma * inv / n) * (n * dy - sum_dy - xhat * sum_dy_xhat)
+    return dx.astype(in_dtype), sum_dy_xhat, sum_dy
+
+
+_bn_train_norm.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 def batchnorm_apply(params: Params, state: State, x: jax.Array, *,
                     train: bool) -> Tuple[jax.Array, State]:
     """Torch-parity BatchNorm over NHWC.
@@ -119,25 +178,25 @@ def batchnorm_apply(params: Params, state: State, x: jax.Array, *,
     momentum=0.1).  In the data-parallel setting the batch stats are the
     *local shard's* stats — matching the reference, where each replica's BN
     sees only its own shard (SURVEY.md §7 "BatchNorm semantics in DP").
+
+    Statistics and normalization math always run in f32 — summing tens of
+    thousands of bf16 activations per channel would lose the mean — and the
+    result is cast back to the activation dtype (no-op for f32).
     """
     if train:
-        axes = (0, 1, 2)
-        mean = jnp.mean(x, axes)
-        var = jnp.mean(jnp.square(x - mean), axes)  # biased
+        y, mean, var = _bn_train_norm(x, params["gamma"], params["beta"])
         n = x.shape[0] * x.shape[1] * x.shape[2]
         unbiased = var * (n / max(n - 1, 1))
         new_state = {
             "mean": (1 - BN_MOMENTUM) * state["mean"] + BN_MOMENTUM * mean,
             "var": (1 - BN_MOMENTUM) * state["var"] + BN_MOMENTUM * unbiased,
         }
-        use_mean, use_var = mean, var
-    else:
-        new_state = state
-        use_mean, use_var = state["mean"], state["var"]
+        return y, new_state
 
-    inv = lax.rsqrt(use_var + BN_EPS)
-    y = (x - use_mean) * inv * params["gamma"] + params["beta"]
-    return y, new_state
+    xf = x.astype(jnp.float32)
+    inv = lax.rsqrt(state["var"] + BN_EPS)
+    y = (xf - state["mean"]) * inv * params["gamma"] + params["beta"]
+    return y.astype(x.dtype), state
 
 
 # ---------------------------------------------------------------------------

@@ -15,7 +15,9 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
     logits: [N, C] float; labels: [N] int.  Computed via log-sum-exp for
     stability (identical math to torch's CrossEntropyLoss mean reduction).
+    Always reduced in f32 so bf16 compute mode keeps a full-precision loss.
     """
+    logits = logits.astype(jnp.float32)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     picked = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
     return jnp.mean(logz - picked)

@@ -77,6 +77,7 @@ class Trainer:
                  seed: int = SEED, augment: bool = True,
                  sgd_cfg: sgd.SGDConfig = sgd.SGDConfig(),
                  profile_phases: bool = False,
+                 precision: str = "f32",
                  reshuffle_each_epoch: bool = False,
                  limit_train_batches: Optional[int] = None,
                  limit_eval_batches: Optional[int] = None,
@@ -89,6 +90,15 @@ class Trainer:
         self.global_batch = global_batch
         self.log = log
         self.profile_phases = profile_phases
+        # Compute precision: "f32" (reference parity, the default) or "bf16"
+        # (mixed precision: f32 master weights/optimizer/BN statistics/loss,
+        # bf16 conv+matmul activations — the MXU's native mode).
+        if precision not in ("f32", "bf16"):
+            raise ValueError(f"precision must be 'f32' or 'bf16', "
+                             f"got {precision!r}")
+        self.precision = precision
+        self.compute_dtype = compute_dtype = (
+            jnp.bfloat16 if precision == "bf16" else None)
         self.augment = augment
         self.seed = seed
         # The reference never reshuffles across epochs (no sampler.set_epoch
@@ -145,10 +155,13 @@ class Trainer:
         self.strategy_name = strategy
         strat = get_strategy(strategy)
         self.train_step = steplib.make_train_step(
-            self.apply_fn, strat, self.mesh, sgd_cfg, augment=augment)
+            self.apply_fn, strat, self.mesh, sgd_cfg, augment=augment,
+            compute_dtype=compute_dtype)
         self.train_window = steplib.make_train_window(
-            self.apply_fn, strat, self.mesh, sgd_cfg, augment=augment)
-        self.eval_window = steplib.make_eval_window(self.apply_fn, self.mesh)
+            self.apply_fn, strat, self.mesh, sgd_cfg, augment=augment,
+            compute_dtype=compute_dtype)
+        self.eval_window = steplib.make_eval_window(
+            self.apply_fn, self.mesh, compute_dtype=compute_dtype)
         if profile_phases:
             self._fwd_only = self._make_fwd_only()
 
@@ -194,8 +207,10 @@ class Trainer:
         from ..parallel.mesh import DATA_AXIS
         from jax import lax
 
+        from ..train.step import maybe_cast
+
         def body(params, bn_state, images, labels):
-            x = aug.normalize(images)
+            x = maybe_cast(aug.normalize(images), self.compute_dtype)
             logits, _ = self.apply_fn(params, bn_state, x, train=True)
             return lax.pmean(cross_entropy(logits, labels), DATA_AXIS)
 
@@ -317,12 +332,15 @@ class Trainer:
             step_key = jax.random.fold_in(key, it)
             x, y = self._put(imgs, labs)
             t0 = time.time()
-            jax.block_until_ready(
+            # np.asarray (a real value fetch) is the fence: under the
+            # tunneled TPU backend block_until_ready can return before the
+            # computation finishes, which would time dispatch, not compute.
+            np.asarray(
                 self._fwd_only(self.state.params, self.state.bn_state, x, y))
             fwd_time = time.time() - t0
             t0 = time.time()
             self.state, loss = self.train_step(self.state, step_key, x, y)
-            loss = float(jax.block_until_ready(loss))
+            loss = float(loss)  # value fetch = completion fence
             # The fused step contains its own forward; the separately-timed
             # forward-only program is ONLY used to report the reference's
             # fwd/bwd split (backward ≈ fused − forward) and is excluded

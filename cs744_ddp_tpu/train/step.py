@@ -34,6 +34,11 @@ from .. import parallel
 from ..parallel.mesh import DATA_AXIS
 
 
+def maybe_cast(x: jax.Array, compute_dtype) -> jax.Array:
+    """Cast activations to the compute dtype (None = keep f32)."""
+    return x.astype(compute_dtype) if compute_dtype else x
+
+
 class TrainState(NamedTuple):
     params: Any
     bn_state: Any
@@ -52,7 +57,8 @@ def init_train_state(init_fn, key: jax.Array) -> TrainState:
 
 def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
                     mesh: Mesh, cfg: sgd.SGDConfig = sgd.SGDConfig(),
-                    *, augment: bool = True) -> Callable:
+                    *, augment: bool = True,
+                    compute_dtype=None) -> Callable:
     """Build the jitted train step.
 
     step(state, key, images_u8[B,32,32,3], labels[B]) -> (state, loss)
@@ -71,6 +77,7 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
         @jax.jit
         def single_step(state: TrainState, key, images, labels):
             x = aug.augment(key, images) if augment else aug.normalize(images)
+            x = maybe_cast(x, compute_dtype)
 
             def loss_fn(p):
                 logits, new_bn = apply_fn(p, state.bn_state, x, train=True)
@@ -88,6 +95,7 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
         # Distinct augmentation stream per shard, deterministic in (key, pos).
         key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
         x = aug.augment(key, images) if augment else aug.normalize(images)
+        x = maybe_cast(x, compute_dtype)
 
         def loss_fn(p):
             logits, new_bn = apply_fn(p, bn_state, x, train=True)
@@ -128,7 +136,8 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
 def make_train_window(apply_fn: Callable,
                       strategy: parallel.strategies.Strategy, mesh: Mesh,
                       cfg: sgd.SGDConfig = sgd.SGDConfig(),
-                      *, augment: bool = True) -> Callable:
+                      *, augment: bool = True,
+                      compute_dtype=None) -> Callable:
     """Windowed train step: W iterations per dispatch via ``lax.scan``.
 
     window(state, key, epoch_images[NB,B,32,32,3], epoch_labels[NB,B],
@@ -157,6 +166,7 @@ def make_train_window(apply_fn: Callable,
             if axis_ok:
                 k = jax.random.fold_in(k, lax.axis_index(DATA_AXIS))
             x = aug.augment(k, images) if augment else aug.normalize(images)
+            x = maybe_cast(x, compute_dtype)
 
             def loss_fn(p):
                 logits, new_bn = apply_fn(p, bn_state, x, train=True)
@@ -233,6 +243,7 @@ def masked_eval_counts(logits: jax.Array, labels: jax.Array):
     masking/accounting semantics cannot drift apart."""
     valid = labels >= 0
     safe = jnp.maximum(labels, 0)
+    logits = logits.astype(jnp.float32)  # full-precision loss in bf16 mode
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
     loss_sum = jnp.sum(jnp.where(valid, logz - picked, 0.0))
@@ -240,7 +251,8 @@ def masked_eval_counts(logits: jax.Array, labels: jax.Array):
     return loss_sum, correct
 
 
-def make_eval_window(apply_fn: Callable, mesh: Mesh) -> Callable:
+def make_eval_window(apply_fn: Callable, mesh: Mesh, *,
+                     compute_dtype=None) -> Callable:
     """Whole-test-set evaluation in ONE dispatch: scan over [T,B,...] staged
     batches, psum counts across the mesh.  Returns (loss_sum, correct)
     over all valid (label >= 0) examples."""
@@ -248,7 +260,7 @@ def make_eval_window(apply_fn: Callable, mesh: Mesh) -> Callable:
     def scan_eval(params, bn_state, images, labels):
         def one(carry, xs):
             imgs, labs = xs
-            x = aug.normalize(imgs)
+            x = maybe_cast(aug.normalize(imgs), compute_dtype)
             logits, _ = apply_fn(params, bn_state, x, train=False)
             loss_sum, correct = masked_eval_counts(logits, labs)
             l, c = carry
@@ -276,7 +288,8 @@ def make_eval_window(apply_fn: Callable, mesh: Mesh) -> Callable:
     return evaluate
 
 
-def make_eval_step(apply_fn: Callable, mesh: Mesh) -> Callable:
+def make_eval_step(apply_fn: Callable, mesh: Mesh, *,
+                   compute_dtype=None) -> Callable:
     """Jitted eval step over a sharded batch.
 
     Returns (loss_sum, correct) summed over the GLOBAL batch via psum —
@@ -286,7 +299,7 @@ def make_eval_step(apply_fn: Callable, mesh: Mesh) -> Callable:
     """
 
     def shard_body(params, bn_state, images, labels):
-        x = aug.normalize(images)
+        x = maybe_cast(aug.normalize(images), compute_dtype)
         logits, _ = apply_fn(params, bn_state, x, train=False)
         # Reference accumulates per-batch mean CE; we return the per-example
         # sum so partial final batches stay exact, and divide on the host.

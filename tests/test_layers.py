@@ -94,3 +94,71 @@ def test_torch_default_init_bounds():
     bound = 1.0 / np.sqrt(512)
     w = np.asarray(p["w"])
     assert w.min() >= -bound and w.max() <= bound
+
+
+def test_batchnorm_fused_vjp_matches_autodiff():
+    """The custom_vjp BN backward (closed-form fused gradient) must equal
+    autodiff through a straightforward two-pass BN implementation, for all
+    of dx, dgamma, dbeta — and the backward must also match torch's."""
+    import torch
+
+    key = jax.random.PRNGKey(11)
+    x = jax.random.normal(key, (8, 5, 5, 6), jnp.float32) * 2.0 + 0.3
+    gamma = jnp.linspace(0.5, 1.5, 6)
+    beta = jnp.linspace(-0.2, 0.2, 6)
+    dy = jax.random.normal(jax.random.fold_in(key, 1), x.shape)
+
+    def fused(x, g, b):
+        y, _, _ = layers._bn_train_norm(x, g, b)
+        return jnp.vdot(y, dy)
+
+    def ref(x, g, b):
+        mean = jnp.mean(x, (0, 1, 2))
+        var = jnp.mean(jnp.square(x - mean), (0, 1, 2))
+        y = (x - mean) * jax.lax.rsqrt(var + layers.BN_EPS) * g + b
+        return jnp.vdot(y, dy)
+
+    g1 = jax.grad(fused, argnums=(0, 1, 2))(x, gamma, beta)
+    g2 = jax.grad(ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+    # Torch cross-check of the same cotangent contraction.
+    xt = torch.tensor(np.asarray(x).transpose(0, 3, 1, 2),
+                      requires_grad=True)
+    bn = torch.nn.BatchNorm2d(6, eps=layers.BN_EPS)
+    with torch.no_grad():
+        bn.weight.copy_(torch.tensor(np.asarray(gamma)))
+        bn.bias.copy_(torch.tensor(np.asarray(beta)))
+    out = bn(xt)
+    out.backward(torch.tensor(np.asarray(dy).transpose(0, 3, 1, 2)))
+    np.testing.assert_allclose(
+        np.asarray(g1[0]), xt.grad.numpy().transpose(0, 2, 3, 1),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1[1]), bn.weight.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1[2]), bn.bias.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_layers_follow_activation_dtype():
+    """bf16 activations must flow through conv/linear/pool in bf16 (master
+    params stay f32), while BN statistics stay f32 internally."""
+    key = jax.random.PRNGKey(0)
+    p = layers.conv2d_init(key, 3, 8, 3)
+    x = jnp.zeros((2, 8, 8, 3), jnp.bfloat16)
+    y = layers.conv2d_apply(p, x)
+    assert y.dtype == jnp.bfloat16
+    assert p["w"].dtype == jnp.float32
+
+    bp, bs = layers.batchnorm_init(8)
+    yb, ns = layers.batchnorm_apply(bp, bs, y + 1.0, train=True)
+    assert yb.dtype == jnp.bfloat16
+    assert ns["mean"].dtype == jnp.float32 and ns["var"].dtype == jnp.float32
+
+    lp = layers.linear_init(key, 8, 4)
+    yl = layers.linear_apply(lp, yb.reshape(2, -1)[:, :8])
+    assert yl.dtype == jnp.bfloat16
+
+    assert layers.maxpool2x2(yb).dtype == jnp.bfloat16

@@ -258,3 +258,34 @@ def test_trainer_run_prints_reference_schedule(tmp_path, mesh1):
     assert "Test set: Average loss:" in text
     # First window excluded from timing report (reference main.py:51).
     assert "Average Pass time in iter 20 is" not in text
+
+
+def test_bf16_precision_trains_and_evaluates(tmp_path, mesh4):
+    """Mixed-precision mode: master params stay f32, training converges on
+    the synthetic split, and the eval path runs under bf16 activations."""
+    tr = Trainer(model=tiny_cnn(), strategy="ddp", mesh=mesh4,
+                 global_batch=64, data_dir=str(tmp_path), augment=False,
+                 precision="bf16", log=lambda s: None)
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree.leaves(tr.state.params))
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for it, (imgs, labs) in enumerate(_shard_batches(
+            tr.train_split, 4, 64, 0, shuffle=True)):
+        if it >= 30:
+            break
+        x, y = tr._put(imgs, labs)
+        tr.state, loss = tr.train_step(tr.state, jax.random.fold_in(key, it),
+                                       x, y)
+        losses.append(float(loss))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7, losses
+    tr.test_split = cifar10.Split(tr.test_split.images[:128],
+                                  tr.test_split.labels[:128])
+    avg_loss, correct, acc = tr.test_model()
+    assert np.isfinite(avg_loss) and 0 <= correct <= 128
+
+    import pytest
+    with pytest.raises(ValueError):
+        Trainer(model=tiny_cnn(), strategy="ddp", mesh=mesh4,
+                global_batch=64, data_dir=str(tmp_path),
+                precision="fp16", log=lambda s: None)
