@@ -63,6 +63,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cap evaluation batches (smoke runs/benches)")
     p.add_argument("--port", type=int, default=6585,
                    help="coordinator port (reference hardcodes 6585)")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the first trained "
+                        "epoch (XPlane, TensorBoard/Perfetto-viewable) - the "
+                        "superset of the print-based timers (SURVEY.md §5)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save TrainState after each epoch and auto-resume "
+                        "from the latest checkpoint (beyond-parity: the "
+                        "reference has no checkpointing)")
     return p
 
 
@@ -84,7 +92,8 @@ def main(argv=None) -> None:
         limit_train_batches=args.limit_train_batches,
         limit_eval_batches=args.limit_eval_batches,
     )
-    trainer.run(args.epochs)
+    trainer.run(args.epochs, checkpoint_dir=args.checkpoint_dir,
+                profile_dir=args.profile_dir)
 
 
 if __name__ == "__main__":

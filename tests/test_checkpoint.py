@@ -1,0 +1,94 @@
+"""Checkpoint/resume: bitwise-exact continuation (beyond-parity subsystem).
+
+The reference keeps training state only in memory (no torch.save/load —
+SURVEY.md §5).  Here the full TrainState (params, BN running stats, SGD
+momentum) persists per completed epoch, and resume is EXACT: the per-epoch
+key is fold_in(seed, epoch) and the sampler never reshuffles (C6), so
+[0..k) + restore + [k..n) must equal [0..n) in one run, bit for bit.
+"""
+
+import numpy as np
+
+import jax
+
+from cs744_ddp_tpu.data import cifar10
+from cs744_ddp_tpu.train.loop import Trainer
+
+from tinynet import tiny_cnn
+
+
+def shrink(tr, n=256):
+    tr.train_split = cifar10.Split(tr.train_split.images[:n],
+                                   tr.train_split.labels[:n])
+    tr.test_split = cifar10.Split(tr.test_split.images[:128],
+                                  tr.test_split.labels[:128])
+
+
+def make(tmp_path, mesh):
+    tr = Trainer(model=tiny_cnn(), strategy="ddp", mesh=mesh,
+                 global_batch=64, data_dir=str(tmp_path), augment=True,
+                 limit_eval_batches=1, log=lambda s: None)
+    shrink(tr)
+    return tr
+
+
+def test_resume_is_bitwise_exact(tmp_path, mesh4):
+    ckpt = tmp_path / "ckpt"
+
+    # Continuous 3-epoch run (no checkpointing).
+    tr_ref = make(tmp_path, mesh4)
+    tr_ref.run(3)
+
+    # 2 epochs with checkpointing...
+    tr_a = make(tmp_path, mesh4)
+    tr_a.run(2, checkpoint_dir=str(ckpt))
+
+    # ...then a FRESH process-equivalent Trainer resumes epoch 2.
+    lines = []
+    tr_b = make(tmp_path, mesh4)
+    tr_b.log = lines.append
+    tr_b.run(3, checkpoint_dir=str(ckpt))
+    assert any("Resumed from checkpoint: epoch 2" in l for l in lines)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        tr_ref.state, tr_b.state)
+
+
+def test_restore_errors_without_checkpoint(tmp_path, mesh4):
+    import pytest
+    from cs744_ddp_tpu.train.checkpoint import CheckpointManager
+    mngr = CheckpointManager(str(tmp_path / "empty"))
+    assert mngr.latest_epoch() is None
+    tr = make(tmp_path, mesh4)
+    with pytest.raises(FileNotFoundError):
+        mngr.restore(tr.state)
+    mngr.close()
+
+
+def test_checkpoint_dir_rejects_foreign_config(tmp_path, mesh4):
+    """Reusing a checkpoint dir under a different training config must fail
+    loudly, not deep-fail in orbax or silently resume foreign state."""
+    import pytest
+    ckpt = str(tmp_path / "ckpt")
+    tr = make(tmp_path, mesh4)
+    tr.run(1, checkpoint_dir=ckpt)
+
+    tr2 = Trainer(model=tiny_cnn(), strategy="allreduce", mesh=mesh4,
+                  global_batch=64, data_dir=str(tmp_path), augment=True,
+                  limit_eval_batches=1, log=lambda s: None)
+    shrink(tr2)
+    with pytest.raises(ValueError, match="different training config"):
+        tr2.run(2, checkpoint_dir=ckpt)
+
+
+def test_run_with_all_epochs_checkpointed_logs_and_exits(tmp_path, mesh4):
+    ckpt = str(tmp_path / "ckpt")
+    tr = make(tmp_path, mesh4)
+    tr.run(1, checkpoint_dir=ckpt)
+    lines = []
+    tr2 = make(tmp_path, mesh4)
+    tr2.log = lines.append
+    tr2.run(1, checkpoint_dir=ckpt)
+    assert any("nothing to run" in l for l in lines)

@@ -89,3 +89,18 @@ def test_cli_rejects_unknown_strategy(tmp_path):
     import pytest
     with pytest.raises(SystemExit):
         cli.main(["--strategy", "zero_redundancy"])
+
+
+def test_profile_dir_writes_xplane_trace(tmp_path, mesh4):
+    """--profile-dir must capture a jax.profiler trace of the first epoch."""
+    import glob
+    import os
+
+    tr = Trainer(model=tiny_cnn(), strategy="allreduce", mesh=mesh4,
+                 global_batch=64, data_dir=str(tmp_path), augment=False,
+                 limit_train_batches=2, limit_eval_batches=1,
+                 log=lambda s: None)
+    tr.run(1, profile_dir=str(tmp_path / "trace"))
+    found = glob.glob(str(tmp_path / "trace" / "**" / "*.xplane.pb"),
+                      recursive=True)
+    assert found, os.listdir(tmp_path / "trace")
