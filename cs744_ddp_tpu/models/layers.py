@@ -166,7 +166,13 @@ def _bn_train_bwd(res, cts):
     sum_dy = jnp.sum(dy, axes)
     sum_dy_xhat = jnp.sum(dy * xhat, axes)
     dx = (gamma * inv / n) * (n * dy - sum_dy - xhat * sum_dy_xhat)
-    return dx.astype(in_dtype), sum_dy_xhat, sum_dy
+    # Fusion fence: without it, XLA:TPU's post-main-fusion pass SIGILLs
+    # compiling models with more than ~8 of these custom backward blocks
+    # inside shard_map (observed on v5e; vgg13/16/19 and resnet18 all
+    # crashed, vgg11 compiled).  The barrier caps the fusion cluster at the
+    # BN boundary and costs nothing measurable; the CPU backend strips it.
+    return lax.optimization_barrier(
+        (dx.astype(in_dtype), sum_dy_xhat, sum_dy))
 
 
 _bn_train_norm.defvjp(_bn_train_fwd, _bn_train_bwd)

@@ -155,6 +155,7 @@ class Trainer:
         self.state = meshlib.put_global_tree(
             self.state, meshlib.replicated(self.mesh))
         self.strategy_name = strategy
+        self.sgd_cfg = sgd_cfg
         strat = get_strategy(strategy)
         self.train_step = steplib.make_train_step(
             self.apply_fn, strat, self.mesh, sgd_cfg, augment=augment,
@@ -394,7 +395,10 @@ class Trainer:
                 "seed": self.seed, "precision": self.precision,
                 "global_batch": self.global_batch, "world": self.world,
                 "augment": self.augment,
-                "reshuffle_each_epoch": self.reshuffle_each_epoch})
+                "reshuffle_each_epoch": self.reshuffle_each_epoch,
+                "lr": self.sgd_cfg.lr, "momentum": self.sgd_cfg.momentum,
+                "weight_decay": self.sgd_cfg.weight_decay,
+                "limit_train_batches": self.limit_train_batches})
             if mngr.latest_epoch() is not None:
                 self.state, start_epoch = mngr.restore(self.state)
                 self.log(f"Resumed from checkpoint: epoch {start_epoch}")

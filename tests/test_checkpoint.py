@@ -92,3 +92,21 @@ def test_run_with_all_epochs_checkpointed_logs_and_exits(tmp_path, mesh4):
     tr2.log = lines.append
     tr2.run(1, checkpoint_dir=ckpt)
     assert any("nothing to run" in l for l in lines)
+
+
+def test_checkpoint_dir_rejects_different_hyperparameters(tmp_path, mesh4):
+    """Resume with a different lr must fail the config guard — a silent
+    optimizer swap would break the bitwise-exact-resume contract."""
+    import pytest
+    from cs744_ddp_tpu.ops import sgd
+    ckpt = str(tmp_path / "ckpt")
+    tr = make(tmp_path, mesh4)
+    tr.run(1, checkpoint_dir=ckpt)
+
+    tr2 = Trainer(model=tiny_cnn(), strategy="ddp", mesh=mesh4,
+                  global_batch=64, data_dir=str(tmp_path), augment=True,
+                  sgd_cfg=sgd.SGDConfig(lr=0.001), limit_eval_batches=1,
+                  log=lambda s: None)
+    shrink(tr2)
+    with pytest.raises(ValueError, match="different training config"):
+        tr2.run(2, checkpoint_dir=ckpt)

@@ -39,11 +39,13 @@ def test_profile_phases_reports_fwd_bwd_split(tmp_path, mesh4):
     assert "Forward Pass time in iter 40 is" in text
     assert "Backward Pass time in iter 40 is" in text
     assert "Average Pass time in iter 40 is" in text
-    # Steady-state samples exist and phases are consistent in the mean:
-    # forward-only and full-step are separately-timed jit'd calls, so
-    # individual pairs can invert under scheduler noise, but the means
-    # over 25 samples must satisfy fwd <= total (10% jitter slack —
-    # catches the forward timer accidentally measuring the whole step).
+    # Steady-state samples exist and the phases are sane in the mean.
+    # NOTE the bound is a CEILING, not a subset check: on this tiny model
+    # both timers are dispatch-dominated (fwd-only and full-step cost about
+    # the same per call, and individual pairs invert under scheduler
+    # noise), so mean(fwd) < mean(step) does NOT hold reliably here.  What
+    # this protects is grosser breakage: the two programs being swapped or
+    # the fwd timer degenerating (e.g. timing multiple steps).
     assert len(timers.steady_step_times) == 45 - 20
     assert len(timers.steady_forward_times) == 45 - 20
     assert (np.mean(timers.steady_forward_times)
