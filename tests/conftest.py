@@ -23,6 +23,23 @@ jax.config.update("jax_platforms", "cpu")
 assert jax.devices()[0].platform == "cpu", (
     "tests must run on the virtual-device CPU backend")
 
+# Persist XLA compilations (same cache bench.py uses): saves ~4 min of
+# repeated CPU-backend compiles across suite runs.  The deviceless TPU AOT
+# client cannot DESERIALIZE cache entries (jax warns and recompiles — hence
+# the filter); everything else hits.
+import warnings  # noqa: E402
+
+warnings.filterwarnings(
+    "ignore", message="Error reading persistent compilation cache entry")
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+except Exception:
+    pass  # older jax without the knobs: suite still runs, just slower
+
 import pytest  # noqa: E402
 
 
