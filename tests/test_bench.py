@@ -23,7 +23,8 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     result = bench.run_bench(matrix=True, sweep=True, max_iters=8,
                              global_batch=64, models=("tiny",),
                              strategies=("allreduce", "ddp"),
-                             headline_model="tiny", log=lambda s: None)
+                             headline_model="tiny", peak_batch_per_chip=16,
+                             log=lambda s: None)
     # Driver contract head.
     assert result["metric"] == "cifar10_tiny_images_per_sec_per_chip"
     assert result["unit"] == "images/sec/chip"
@@ -34,6 +35,10 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     # Strategy x model matrix: one positive entry per pair.
     assert set(result["matrix"]) == {"tiny/allreduce", "tiny/ddp"}
     assert all(v > 0 for v in result["matrix"].values())
+
+    # Peak entry: bf16 frontier config, well-formed and positive.
+    assert result["peak"]["images_per_sec_per_chip"] > 0
+    assert "bf16" in result["peak"]["config"]
 
     # Scaling sweep: 1,2,4,8 devices; efficiency is per-chip relative to
     # the 1-device run and must be finite/positive; 1-device eff == 1.
