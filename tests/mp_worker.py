@@ -6,7 +6,7 @@ per node, rank from the command line, rendezvous at a coordinator address
 runtime: ``jax.distributed.initialize`` (via parallel.mesh), a mesh spanning
 both processes' devices, and gloo cross-process CPU collectives.
 
-Usage: mp_worker.py <process_id> <num_processes> <port> <outdir>
+Usage: mp_worker.py <process_id> <num_processes> <port> <outdir> [strategy]
 The launcher must set JAX_PLATFORMS=cpu and
 XLA_FLAGS=--xla_force_host_platform_device_count=4 in the environment.
 """
@@ -27,6 +27,7 @@ N_STEPS = 3
 def main() -> None:
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     outdir = sys.argv[4]
+    strategy = sys.argv[5] if len(sys.argv) > 5 else "allreduce"
     tests_dir = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, tests_dir)                    # tinynet
     sys.path.insert(0, os.path.dirname(tests_dir))   # cs744_ddp_tpu
@@ -44,7 +45,7 @@ def main() -> None:
     from tinynet import run_steps, tiny_cnn
 
     log = lambda s: print(f"[proc {pid}] {s}", flush=True)
-    tr = Trainer(model=tiny_cnn(), strategy="allreduce", global_batch=64,
+    tr = Trainer(model=tiny_cnn(), strategy=strategy, global_batch=64,
                  data_dir=os.path.join(outdir, "data"), augment=False,
                  log=log)
     assert tr.world == jax.device_count() == 4 * nproc

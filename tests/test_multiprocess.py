@@ -20,6 +20,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -39,7 +40,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_rendezvous_matches_single_controller(tmp_path, mesh8):
+@pytest.mark.parametrize("strategy", ["allreduce", "ddp"])
+def test_two_process_rendezvous_matches_single_controller(tmp_path, mesh8,
+                                                          strategy):
     # Pre-build the native library so the workers don't race the first build.
     native.load_library()
 
@@ -49,7 +52,8 @@ def test_two_process_rendezvous_matches_single_controller(tmp_path, mesh8):
     port = _free_port()
     script = os.path.join(_TESTS_DIR, "mp_worker.py")
     procs = [subprocess.Popen(
-        [sys.executable, script, str(i), "2", str(port), str(tmp_path)],
+        [sys.executable, script, str(i), "2", str(port), str(tmp_path),
+         strategy],
         env=env, cwd=_REPO_ROOT,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for i in range(2)]
@@ -75,7 +79,7 @@ def test_two_process_rendezvous_matches_single_controller(tmp_path, mesh8):
 
     # (2) Single-controller equivalence: the same config in THIS process on
     # the 8-virtual-device mesh takes the same steps.
-    tr = Trainer(model=tiny_cnn(), strategy="allreduce", global_batch=64,
+    tr = Trainer(model=tiny_cnn(), strategy=strategy, global_batch=64,
                  data_dir=str(tmp_path / "data"), augment=False,
                  mesh=mesh8, log=lambda s: None)
     losses = run_steps(tr, N_STEPS)
