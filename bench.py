@@ -14,7 +14,7 @@ Prints ONE JSON line whose head matches the driver contract
     tests/test_bench.py.
 
 Protocol (BASELINE.md): the reference's own measurement design — per-step
-wall-clock fenced with block_until_ready, 20-iteration windows, the first
+wall-clock fenced by fetching the loss values, 20-iteration windows, the first
 window (compile + warmup) excluded — global batch 256, SGD(0.1, 0.9, 1e-4).
 
 vs_baseline: the reference publishes no numbers (BASELINE.json

@@ -8,9 +8,11 @@ Differences from the reference, by design (all documented in BASELINE.md):
     each mesh position sees exactly the shard the reference's
     DistributedSampler would hand that rank (data.sharding);
   * the per-batch phases (augment/forward/loss/backward/sync/step) are one
-    XLA program — timing therefore reports the fused step time, fenced with
-    ``block_until_ready``; an optional split-phase mode additionally times a
-    forward-only program for the reference's fwd/bwd split;
+    XLA program — timing therefore reports the fused step time, fenced by
+    fetching the loss values (under the tunneled TPU backend
+    ``block_until_ready`` can return before computation completes); an
+    optional split-phase mode additionally times a forward-only program
+    for the reference's fwd/bwd split;
   * evaluation runs once across the mesh (psum'd counts) instead of
     redundantly per rank, reporting identical quantities.
 """
@@ -295,7 +297,7 @@ class Trainer:
         """One training epoch with the reference's print/timing schedule.
 
         Default mode runs one compiled dispatch per 20-iteration window
-        (lax.scan inside), timed with block_until_ready fences — the same
+        (lax.scan inside), timed with value-fetch fences — the same
         granularity the reference reports at.  ``profile_phases=True``
         switches to the per-step path, which additionally times a
         forward-only program to report the reference's fwd/bwd split.
@@ -313,7 +315,7 @@ class Trainer:
             self.state, losses = self.train_window(
                 self.state, key, epoch_images, epoch_labels,
                 jnp.int32(start), jnp.zeros((w,), jnp.int8))
-            losses = np.asarray(jax.block_until_ready(losses))
+            losses = np.asarray(losses)  # value fetch = completion fence
             per_iter = (time.time() - t0) / w
             for loss in losses:
                 timers.record(float(loss), per_iter)
@@ -446,7 +448,8 @@ class Trainer:
         # (~6% throughput on v5e), so all keys are materialized up front.
         keys = [jax.device_put(k) for k in
                 jax.random.split(key, nwin + 1)]
-        jax.block_until_ready(keys)
+        for k in keys:
+            np.asarray(k)  # value fetch: keep transfers out of timed region
 
         def dispatch(start, wi):
             self.state, losses = self.train_window(

@@ -4,9 +4,10 @@ The reference brackets forward and backward+sync+step with ``time.time()``,
 averages over 20-iteration windows, skips the FIRST window from the timing
 report (compilation/warmup), and prints running loss every 20 iterations
 (``/root/reference/src/Part 1/main.py:28-57``).  This module reproduces that
-schedule exactly — the caller is responsible for fencing with
-``jax.block_until_ready`` so the timers measure real device work rather than
-async dispatch (SURVEY.md §5 "Tracing / profiling").
+schedule exactly — the caller is responsible for fencing each timed region
+with a VALUE FETCH (``np.asarray``/``float``; ``jax.block_until_ready`` can
+return early under the tunneled TPU backend) so the timers measure real
+device work rather than async dispatch (SURVEY.md §5 "Tracing / profiling").
 """
 
 from __future__ import annotations

@@ -182,7 +182,7 @@ def test_ddp_wallclock_not_slower_than_allreduce(mesh8):
         s = state
         for i in range(2):
             s, loss = step(s, jax.random.PRNGKey(i), imgs, labs)
-            jax.block_until_ready(loss)
+            float(loss)  # value fetch = completion fence
         steps[name], states[name] = step, s
 
     times = {"allreduce": [], "ddp": []}
@@ -191,7 +191,7 @@ def test_ddp_wallclock_not_slower_than_allreduce(mesh8):
             t0 = time.time()
             states[name], loss = steps[name](
                 states[name], jax.random.PRNGKey(i), imgs, labs)
-            jax.block_until_ready(loss)
+            float(loss)  # value fetch = completion fence
             times[name].append(time.time() - t0)
 
     # Median over 9 interleaved pairs: robust to per-step scheduler spikes

@@ -28,7 +28,7 @@ def run_steps(trainer, n_steps, *, epoch=0, base_key=0):
         x, y = trainer._put(imgs, labs)
         trainer.state, loss = trainer.train_step(
             trainer.state, jax.random.fold_in(key, it), x, y)
-        losses.append(float(jax.block_until_ready(loss)))
+        losses.append(float(loss))  # value fetch = completion fence
     return losses
 
 
