@@ -56,7 +56,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "stats/loss, bf16 matmul+conv — the MXU native mode)")
     p.add_argument("--profile-phases", action="store_true",
                    help="additionally time a forward-only program to report "
-                        "the reference's fwd/bwd split")
+                        "the reference's fwd/bwd split. NOTE: this per-step "
+                        "mode pays per-call dispatch latency (large on "
+                        "remote/tunneled TPU backends), so phase times can "
+                        "dwarf the fused windowed step time the default "
+                        "mode reports; use --profile-dir for a real trace")
     p.add_argument("--limit-train-batches", type=int, default=None,
                    help="cap train iterations per epoch (smoke runs/benches)")
     p.add_argument("--limit-eval-batches", type=int, default=None,
