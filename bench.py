@@ -113,8 +113,7 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         peak_global = peak_batch_per_chip * ndev
         log(f"[bench] peak: {headline_model}/bf16/batch{peak_global} "
             f"on {ndev} device(s)")
-        ips = _throughput(headline_model,
-                          "ddp" if ndev > 1 else "single", ndev,
+        ips = _throughput(headline_model, headline_strategy, ndev,
                           global_batch=peak_global,
                           max_iters=max(max_iters // 3, 2),
                           data_dir=data_dir, log=lambda s: None,
@@ -159,23 +158,19 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
 
 
 def _enable_compilation_cache() -> None:
-    """Persist XLA compilations under ./.jax_cache: the matrix compiles six
-    train-window programs (~40 s each on TPU), and they're identical across
-    bench invocations."""
-    try:
-        import jax
-        cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_cache")
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-    except Exception:
-        pass  # older jax without the knobs: bench still runs, just slower
+    """Persist XLA compilations (the matrix compiles six train-window
+    programs, ~40 s each on TPU, identical across bench invocations)."""
+    from cs744_ddp_tpu.utils.compcache import \
+        enable_persistent_compilation_cache
+    enable_persistent_compilation_cache(
+        os.path.dirname(os.path.abspath(__file__)))
 
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser("bench")
     p.add_argument("--no-matrix", action="store_true",
-                   help="headline metric only (fast driver mode)")
+                   help="headline metric only (fast driver mode; also "
+                        "skips the peak entry)")
     p.add_argument("--no-sweep", action="store_true",
                    help="skip the 1..N-device scaling sweep")
     p.add_argument("--no-peak", action="store_true",
@@ -187,7 +182,8 @@ def main(argv=None) -> None:
 
     _enable_compilation_cache()
     result = run_bench(matrix=not args.no_matrix, sweep=not args.no_sweep,
-                       peak=not args.no_peak, max_iters=args.max_iters,
+                       peak=not (args.no_peak or args.no_matrix),
+                       max_iters=args.max_iters,
                        global_batch=args.global_batch)
     print(json.dumps(result))
 

@@ -160,12 +160,20 @@ def _bn_train_bwd(res, cts):
     xhat_stored, inv, gamma = res
     in_dtype = xhat_stored.dtype
     xhat = xhat_stored.astype(jnp.float32)
-    dy = cts[0].astype(jnp.float32)  # ct_mean/ct_var structurally zero
+    dy = cts[0].astype(jnp.float32)
     axes = (0, 1, 2)
     n = xhat.shape[0] * xhat.shape[1] * xhat.shape[2]
     sum_dy = jnp.sum(dy, axes)
     sum_dy_xhat = jnp.sum(dy * xhat, axes)
     dx = (gamma * inv / n) * (n * dy - sum_dy - xhat * sum_dy_xhat)
+    # Exact cotangent terms for the mean/var outputs (normally literal
+    # zeros — they feed only the non-differentiated running-stats update,
+    # and XLA folds the zero contributions — but a future loss term
+    # touching the statistics gets CORRECT gradients, not silent zeros):
+    # d mean / d x_i = 1/n;  d var / d x_i = 2 (x_i - mean) / n.
+    ct_mean = cts[1].astype(jnp.float32)
+    ct_var = cts[2].astype(jnp.float32)
+    dx = dx + ct_mean / n + (2.0 / n) * ct_var * (xhat / inv)
     # Fusion fence: without it, XLA:TPU's post-main-fusion pass SIGILLs
     # compiling models with more than ~8 of these custom backward blocks
     # inside shard_map (observed on v5e; vgg13/16/19 and resnet18 all

@@ -392,6 +392,12 @@ class Trainer:
         mngr = None
         if checkpoint_dir is not None:
             from .checkpoint import CheckpointManager
+            # param_tree digests the full state structure (shapes+dtypes),
+            # so two "custom" models or any architecture drift fail the
+            # guard; real_data catches the silent synthetic-fallback case
+            # (same config keys, different dataset).
+            param_tree = jax.tree.map(
+                lambda a: f"{a.dtype}{list(a.shape)}", self.state)
             mngr = CheckpointManager(checkpoint_dir, config={
                 "model": self.model_name, "strategy": self.strategy_name,
                 "seed": self.seed, "precision": self.precision,
@@ -400,7 +406,9 @@ class Trainer:
                 "reshuffle_each_epoch": self.reshuffle_each_epoch,
                 "lr": self.sgd_cfg.lr, "momentum": self.sgd_cfg.momentum,
                 "weight_decay": self.sgd_cfg.weight_decay,
-                "limit_train_batches": self.limit_train_batches})
+                "limit_train_batches": self.limit_train_batches,
+                "real_data": self.real_data,
+                "state_digest": str(param_tree)})
             if mngr.latest_epoch() is not None:
                 self.state, start_epoch = mngr.restore(self.state)
                 self.log(f"Resumed from checkpoint: epoch {start_epoch}")

@@ -1,0 +1,20 @@
+"""Persistent XLA compilation cache shared by bench.py and the test suite.
+
+One knob, one location: the cache lives under <repo>/.jax_cache (gitignored)
+and entries below the min-compile-time threshold are not persisted.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_compilation_cache(repo_root: str) -> None:
+    """Best-effort: older jax without the config knobs just runs uncached."""
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(repo_root, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass

@@ -31,14 +31,11 @@ import warnings  # noqa: E402
 
 warnings.filterwarnings(
     "ignore", message="Error reading persistent compilation cache entry")
-try:
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-except Exception:
-    pass  # older jax without the knobs: suite still runs, just slower
+from cs744_ddp_tpu.utils.compcache import \
+    enable_persistent_compilation_cache  # noqa: E402
+
+enable_persistent_compilation_cache(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 

@@ -162,3 +162,26 @@ def test_layers_follow_activation_dtype():
     assert yl.dtype == jnp.bfloat16
 
     assert layers.maxpool2x2(yb).dtype == jnp.bfloat16
+
+
+def test_batchnorm_vjp_mean_var_cotangents_exact():
+    """Differentiating THROUGH the mean/var outputs (e.g. a statistics
+    regularizer) must produce the exact gradient, not silent zeros."""
+    key = jax.random.PRNGKey(5)
+    x = jax.random.normal(key, (4, 3, 3, 2)) * 1.5 + 0.2
+    gamma = jnp.ones((2,))
+    beta = jnp.zeros((2,))
+
+    def fused(x):
+        y, mean, var = layers._bn_train_norm(x, gamma, beta)
+        return jnp.sum(y) + 3.0 * jnp.sum(mean) + 0.5 * jnp.sum(var)
+
+    def ref(x):
+        mean = jnp.mean(x, (0, 1, 2))
+        var = jnp.mean(jnp.square(x - mean), (0, 1, 2))
+        y = (x - mean) * jax.lax.rsqrt(var + layers.BN_EPS) * gamma + beta
+        return jnp.sum(y) + 3.0 * jnp.sum(mean) + 0.5 * jnp.sum(var)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(fused)(x)),
+                               np.asarray(jax.grad(ref)(x)),
+                               rtol=1e-5, atol=1e-6)
