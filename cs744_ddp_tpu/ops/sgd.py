@@ -25,7 +25,6 @@ import jax.numpy as jnp
 
 class SGDState(NamedTuple):
     momentum: Any          # pytree like params; velocity buffers
-    step: jax.Array        # scalar int32 step counter
 
 
 class SGDConfig(NamedTuple):
@@ -36,7 +35,7 @@ class SGDConfig(NamedTuple):
 
 def init(params: Any) -> SGDState:
     zeros = jax.tree.map(jnp.zeros_like, params)
-    return SGDState(momentum=zeros, step=jnp.zeros((), jnp.int32))
+    return SGDState(momentum=zeros)
 
 
 def update(params: Any, grads: Any, state: SGDState,
@@ -46,4 +45,4 @@ def update(params: Any, grads: Any, state: SGDState,
     new_vel = jax.tree.map(lambda v, dd: cfg.momentum * v + dd,
                            state.momentum, d)
     new_params = jax.tree.map(lambda p, v: p - cfg.lr * v, params, new_vel)
-    return new_params, SGDState(momentum=new_vel, step=state.step + 1)
+    return new_params, SGDState(momentum=new_vel)

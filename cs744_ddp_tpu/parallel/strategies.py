@@ -8,13 +8,17 @@ spectrum (SURVEY.md §2.3):
   * ``gather_scatter``  — reference Part 2a (``main.py:117-127``):
     per parameter, rank 0 gathers every worker's grad, means them, scatters
     the average back — one blocking gather + one blocking scatter per leaf,
-    sequentially.  Here: per leaf, ``all_gather`` (a superset of
-    gather-to-root on ICI), the gathered stack zeroed on every mesh position
-    except 0 *before* the mean (root-located compute, like rank 0's
-    ``torch.mean``), then the root's mean broadcast via ``psum``; leaves are
-    chained through ``optimization_barrier`` so the per-leaf collective
-    pairs stay *sequential* in the compiled TPU program, preserving the
-    deliberately-naive blocking-loop cost model for honest benchmarking.
+    sequentially.  Here: a ROOT-EQUIVALENT COMM PATTERN WITH REPLICATED
+    COMPUTE — per leaf, ``all_gather`` (a superset of gather-to-root on
+    ICI), then the gathered stack is zeroed on every mesh position except 0
+    before the mean, and the root's mean is broadcast via ``psum``.  In
+    SPMD every position executes the (cheap) masked mean; what matches the
+    reference's rank-0 bottleneck is the *communication* shape — two
+    sequential collectives per leaf — which is the term that dominates its
+    cost model.  Leaves are chained through ``optimization_barrier`` so the
+    per-leaf collective pairs stay *sequential* in the compiled TPU
+    program, preserving the deliberately-naive blocking-loop cost model
+    for honest benchmarking.
 
   * ``per_param_psum``  — reference Part 2b (``main.py:116-119``):
     one blocking all-reduce per parameter leaf, sequentially, no fusion.
@@ -95,7 +99,12 @@ def per_param_psum(grads: Any, axis_name: str) -> Any:
 
 
 def gather_scatter(grads: Any, axis_name: str) -> Any:
-    """Root-mediated gather -> mean-on-root -> broadcast (Part 2a parity)."""
+    """Part 2a parity: root-equivalent comm pattern, replicated compute.
+
+    Two sequential collectives per leaf (all_gather, then psum of the
+    root-masked mean) reproduce the reference's gather->mean->scatter
+    communication cost; the masked mean itself runs on every position
+    (SPMD), not only on the root — see the module docstring."""
     idx = lax.axis_index(axis_name)
     leaves, treedef = jax.tree.flatten(grads)
     out: List[Any] = []

@@ -44,7 +44,14 @@ class CheckpointManager:
         self._config_path = os.path.join(directory, "trainer_config.json")
         if config is not None and os.path.exists(self._config_path):
             with open(self._config_path) as f:
-                existing = json.load(f)
+                try:
+                    existing = json.load(f)
+                except json.JSONDecodeError as e:
+                    raise ValueError(
+                        f"checkpoint dir {directory} holds a corrupt "
+                        f"trainer_config.json ({e}); refusing to resume from "
+                        f"an unidentifiable run — delete the directory to "
+                        f"start fresh") from e
             if existing != config:
                 raise ValueError(
                     f"checkpoint dir {directory} belongs to a different "
@@ -54,9 +61,17 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
                                                  create=True),
         )
-        if config is not None and not os.path.exists(self._config_path):
-            with open(self._config_path, "w") as f:
+        if config is not None and not os.path.exists(self._config_path) \
+                and jax.process_index() == 0:
+            # Atomic write (unique temp + rename) from process 0 only:
+            # concurrent writers (two runs racing on one dir) or a crash
+            # mid-write must never leave a torn config that the guard above
+            # would choke on; the pid suffix keeps racing writers off each
+            # other's temp files so the rename source is always complete.
+            tmp = f"{self._config_path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
                 json.dump(config, f)
+            os.replace(tmp, self._config_path)
 
     def latest_epoch(self) -> Optional[int]:
         """Last COMPLETED epoch saved, or None if no checkpoint exists."""

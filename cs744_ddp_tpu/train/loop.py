@@ -13,6 +13,9 @@ Differences from the reference, by design (all documented in BASELINE.md):
     ``block_until_ready`` can return before computation completes); an
     optional split-phase mode additionally times a forward-only program
     for the reference's fwd/bwd split;
+  * the ragged final train batch (drop_last=False) runs through a second
+    compiled step at its true static shape — exact short-batch BN/CE
+    semantics, same iteration count as the reference;
   * evaluation runs once across the mesh (psum'd counts) instead of
     redundantly per rank, reporting identical quantities.
 """
@@ -42,16 +45,27 @@ def _shard_batches(split: cifar10.Split, world: int, global_batch: int,
                    reshuffle_each_epoch: bool = False
                    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Yield [global_batch,...] host arrays laid out so that sharding dim 0
-    over the mesh gives device d exactly sampler-rank d's examples."""
+    over the mesh gives device d exactly sampler-rank d's examples.
+
+    The final yield may be SHORT (the ragged tail): the reference's
+    DataLoader uses ``drop_last=False`` (``Part 1/main.py:96-101``), so the
+    short 196th/782nd batch is trained too.  The sampler's wrap-padding
+    guarantees every rank holds the same per-rank count, so the tail is
+    equal-sized across ranks and shards cleanly; it runs through a second
+    compiled step at its own (static) shape — exact short-batch BN/CE
+    semantics, no masking."""
     per = global_batch // world
     idx = sharding.global_epoch_indices(
         len(split.labels), world, seed=seed, shuffle=shuffle, epoch=epoch,
         reshuffle_each_epoch=reshuffle_each_epoch)
-    nbatches = idx.shape[1] // per  # drop ragged tail (static shapes for jit)
-    for b in range(nbatches):
+    nfull = idx.shape[1] // per
+    for b in range(nfull):
         cols = idx[:, b * per:(b + 1) * per].reshape(-1)  # device-major
         # Batch assembly via the native threaded gather (the reference's
         # DataLoader-worker equivalent); falls back to numpy fancy indexing.
+        yield native.gather(split.images, cols), split.labels[cols]
+    if idx.shape[1] % per:
+        cols = idx[:, nfull * per:].reshape(-1)
         yield native.gather(split.images, cols), split.labels[cols]
 
 
@@ -128,10 +142,10 @@ class Trainer:
 
         per_rank_samples = ceil_div(len(self.train_split.labels), self.world)
         per_rank_batch = global_batch // self.world
-        # NOTE: the printed count is ceil (DataLoader drop_last=False parity,
-        # 782 at 50000/64); training itself drops the ragged final batch for
-        # static XLA shapes, so actual iterations are the floor (781).  Both
-        # the drop and this off-by-one are documented in BASELINE.md.
+        # The printed count is ceil (DataLoader drop_last=False parity, 782
+        # at 50000/64) and matches the trained count: the ragged final batch
+        # runs through its own compiled step at its true shape (_shard_batches
+        # docstring), so printed == trained.
         self.log(f"Size of training set is "
                  f"{ceil_div(per_rank_samples, per_rank_batch)}")
         # The reference's test loader uses the PER-RANK batch (256/world,
@@ -173,8 +187,9 @@ class Trainer:
         self._batch_sharding = meshlib.batch_sharding(self.mesh)
         from jax.sharding import NamedSharding, PartitionSpec as P
         self._epoch_sharding = NamedSharding(self.mesh, P(None, meshlib.DATA_AXIS))
-        self._staged_train = None   # (epoch_images, epoch_labels) on device
+        self._staged_train = None   # (epoch_images, epoch_labels, tail)
         self._staged_eval = None
+        self._warmed_tail_shapes = set()
         self.last_epoch_timers: Optional[WindowedTimers] = None
 
     # -- dataset splits (generation-tracked for staging-cache keys) ---------
@@ -227,7 +242,8 @@ class Trainer:
     # -- on-device staging --------------------------------------------------
 
     def _stage_train_epoch(self, epoch: int):
-        """Stage the whole epoch's batches on device as [NB, B, ...] arrays.
+        """Stage the whole epoch's batches on device: full batches as
+        [NB, B, ...] arrays plus the ragged tail batch (or None) separately.
 
         One host->device transfer per epoch instead of one per batch —
         transfers carry a large fixed cost, and the uint8 epoch is ~150 MB.
@@ -242,37 +258,64 @@ class Trainer:
                 self._staged_train[0] == cache_key:
             return self._staged_train[1]
         imgs, labs = [], []
+        tail = None
         for i, l in _shard_batches(
                 self.train_split, self.world, self.global_batch, epoch,
                 shuffle=True, seed=self.seed,
                 reshuffle_each_epoch=self.reshuffle_each_epoch):
+            if i.shape[0] < self.global_batch:   # ragged tail (always last)
+                tail = (meshlib.put_global(i, self._batch_sharding),
+                        meshlib.put_global(l.astype(np.int32),
+                                           self._batch_sharding))
+                break
             imgs.append(i)
             labs.append(l)
             if self.limit_train_batches is not None and \
                     len(imgs) >= self.limit_train_batches:
                 break
-        staged = (
-            meshlib.put_global(np.stack(imgs), self._epoch_sharding),
-            meshlib.put_global(np.stack(labs).astype(np.int32),
-                               self._epoch_sharding))
+        if imgs:
+            full = (meshlib.put_global(np.stack(imgs), self._epoch_sharding),
+                    meshlib.put_global(np.stack(labs).astype(np.int32),
+                                       self._epoch_sharding))
+        else:  # dataset smaller than one global batch: tail-only epoch
+            full = (meshlib.put_global(
+                        np.zeros((0, self.global_batch, 32, 32, 3), np.uint8),
+                        self._epoch_sharding),
+                    meshlib.put_global(
+                        np.zeros((0, self.global_batch), np.int32),
+                        self._epoch_sharding))
+        staged = (full[0], full[1], tail)
         self._staged_train = (cache_key, staged)
         self._warm_train_windows(staged)
         return staged
 
     def _warm_train_windows(self, staged):
-        """AOT-compile both window shapes (full WINDOW and the ragged tail)
-        so mid-epoch compiles never pollute the timers — the windowed
-        analogue of the reference's first-window warmup exclusion."""
-        epoch_images, epoch_labels = staged
+        """AOT-compile every program shape the epoch will dispatch (full
+        WINDOW, the ragged window, and the ragged tail batch's own step) so
+        mid-epoch compiles never pollute the timers — the windowed analogue
+        of the reference's first-window warmup exclusion."""
+        epoch_images, epoch_labels, tail = staged
         nbatches = epoch_images.shape[0]
         key = jax.random.PRNGKey(self.seed)
-        shapes = {min(WINDOW, nbatches)}
+        shapes = {min(WINDOW, nbatches)} if nbatches else set()
         if nbatches % WINDOW:
             shapes.add(nbatches % WINDOW)
         for w in shapes:
             self.train_window.lower(
                 self.state, key, epoch_images, epoch_labels, jnp.int32(0),
                 jnp.zeros((w,), jnp.int8)).compile()
+
+    def _warm_tail_step(self, tail) -> None:
+        """AOT-compile the tail-shape train step (idempotent per shape) so
+        the ragged batch's compile never lands inside a timed iteration.
+        Deliberately NOT done at staging time: the bench path stages epochs
+        but never trains the tail, and would pay a dead compile."""
+        shape = tuple(tail[0].shape)
+        if shape in self._warmed_tail_shapes:
+            return
+        self.train_step.lower(
+            self.state, jax.random.PRNGKey(self.seed), *tail).compile()
+        self._warmed_tail_shapes.add(shape)
 
     def _stage_eval(self):
         cache_key = self._test_gen
@@ -306,7 +349,7 @@ class Trainer:
             return self._train_model_per_step(epoch)
         timers = WindowedTimers(self.log)
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
-        epoch_images, epoch_labels = self._stage_train_epoch(epoch)
+        epoch_images, epoch_labels, tail = self._stage_train_epoch(epoch)
         nbatches = epoch_images.shape[0]
         start = 0
         while start < nbatches:
@@ -320,6 +363,16 @@ class Trainer:
             for loss in losses:
                 timers.record(float(loss), per_iter)
             start += w
+        if tail is not None:
+            # The ragged final batch (drop_last=False parity) through its
+            # own compiled step; host-side fold of the batch index keeps the
+            # canonical (index, position) key order of both other paths.
+            self._warm_tail_step(tail)  # keep the compile out of the timer
+            tail_key = jax.random.fold_in(key, nbatches)
+            t0 = time.time()
+            self.state, loss = self.train_step(self.state, tail_key, *tail)
+            loss = float(loss)  # value fetch = completion fence
+            timers.record(loss, time.time() - t0)
         self.last_epoch_timers = timers
         return timers
 
@@ -327,6 +380,7 @@ class Trainer:
         """Per-step dispatch path (slow; used for the fwd/bwd phase split)."""
         timers = WindowedTimers(self.log)
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
+        self._warm_per_step_tail_shapes()
         for it, (imgs, labs) in enumerate(_shard_batches(
                 self.train_split, self.world, self.global_batch, epoch,
                 shuffle=True, seed=self.seed,
@@ -354,6 +408,33 @@ class Trainer:
             timers.record(loss, step_time, fwd_time)
         self.last_epoch_timers = timers
         return timers
+
+    def _warm_per_step_tail_shapes(self) -> None:
+        """AOT-compile the ragged-tail shapes of the per-step programs.
+
+        The full-batch compile lands in the first (warmup) window, which the
+        reference's protocol excludes — but the tail arrives at the LAST
+        iteration, squarely inside steady state, where a fresh multi-second
+        compile would corrupt steady_step_times and the epoch total.  Warm
+        both per-step programs at the tail shape up front instead."""
+        per = self.global_batch // self.world
+        per_rank = -(-len(self.train_split.labels) // self.world)
+        nfull, tail_per = divmod(per_rank, per)
+        will_train_tail = tail_per and (self.limit_train_batches is None
+                                        or self.limit_train_batches > nfull)
+        if not will_train_tail:
+            return
+        tb = tail_per * self.world
+        x = jax.ShapeDtypeStruct((tb, 32, 32, 3), jnp.uint8,
+                                 sharding=self._batch_sharding)
+        y = jax.ShapeDtypeStruct((tb,), jnp.int32,
+                                 sharding=self._batch_sharding)
+        key = jax.random.PRNGKey(self.seed)
+        if (tb, 32, 32, 3) not in self._warmed_tail_shapes:
+            self.train_step.lower(self.state, key, x, y).compile()
+            self._warmed_tail_shapes.add((tb, 32, 32, 3))
+        self._fwd_only.lower(
+            self.state.params, self.state.bn_state, x, y).compile()
 
     def test_model(self) -> Tuple[float, int, float]:
         """Full-test-set evaluation in one dispatch; prints the reference's
@@ -441,8 +522,13 @@ class Trainer:
         using the reference's measurement design: 20-iter windows, first
         window (compile+warmup) excluded."""
         key = jax.random.PRNGKey(self.seed)
-        epoch_images, epoch_labels = self._stage_train_epoch(0)
+        epoch_images, epoch_labels, _ = self._stage_train_epoch(0)
         nbatches = epoch_images.shape[0]
+        if nbatches == 0:
+            raise ValueError(
+                "steady_state_throughput needs at least one full global "
+                f"batch ({self.global_batch}); the dataset holds only a "
+                "ragged tail")
         w = min(WINDOW, nbatches)  # small datasets: clamp the window
         length_arr = jnp.zeros((w,), jnp.int8)
         nwin = max(2, max_iters // w)

@@ -185,6 +185,37 @@ def test_windowed_path_matches_per_step_path_with_augment(tmp_path, mesh4):
     params_allclose(tr_win.state.params, tr_step.state.params, atol=1e-4)
 
 
+def test_ragged_tail_batch_is_trained(tmp_path, mesh8):
+    """drop_last=False parity (VERDICT r2 item 4): the short final batch is
+    trained — through its own compiled step at its true shape — and the
+    windowed and per-step paths agree on it.
+
+    208 examples / world 8 / global batch 64: per-rank 26 = 3*8 + 2, so the
+    epoch is 3 full batches plus a ragged global tail of 16."""
+    tr_win = make_trainer(tmp_path, mesh8, "ddp")
+    tr_step = make_trainer(tmp_path, mesh8, "ddp", profile_phases=True)
+    for tr in (tr_win, tr_step):
+        tr.train_split = cifar10.Split(tr.train_split.images[:208],
+                                       tr.train_split.labels[:208])
+    t_win = tr_win.train_model(0)
+    t_step = tr_step.train_model(0)
+    # Printed count == trained count: ceil(26 / 8) = 4 iterations.
+    assert t_win.iter_number - 1 == 4
+    assert t_step.iter_number - 1 == 4
+    # Both paths take the same parameter trajectory through the tail.
+    params_allclose(tr_win.state.params, tr_step.state.params, atol=1e-4)
+    # The tail actually MOVED the params: replay only the 3 full windows.
+    tr_full = make_trainer(tmp_path, mesh8, "ddp")
+    tr_full.train_split = cifar10.Split(tr_full.train_split.images[:208],
+                                        tr_full.train_split.labels[:208])
+    tr_full.limit_train_batches = 3
+    tr_full.train_model(0)
+    diffs = [float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+             for a, b in zip(jax.tree.leaves(tr_win.state.params),
+                             jax.tree.leaves(tr_full.state.params))]
+    assert max(diffs) > 1e-6, "tail step was a no-op"
+
+
 def test_staging_cache_invalidates_on_split_replacement(tmp_path, mesh4):
     """Replacing test_split after an eval must restage (not reuse stale
     device arrays)."""
