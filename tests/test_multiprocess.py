@@ -40,7 +40,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("strategy", ["allreduce", "ddp"])
+@pytest.mark.parametrize("strategy", ["gather", "allreduce", "ddp"])
 def test_two_process_rendezvous_matches_single_controller(tmp_path, mesh8,
                                                           strategy):
     # Pre-build the native library so the workers don't race the first build.

@@ -158,7 +158,13 @@ def test_ddp_wallclock_not_slower_than_allreduce(mesh8):
     lose to per-param all-reduce on a model with many parameter leaves
     (ResNet-18, ~60 leaves).  On this XLA version both compile to the same
     fused collective schedule, so this pins ddp step time <= allreduce
-    step time as a wall-clock invariant (margin covers CI timer noise)."""
+    step time as a wall-clock invariant (margin covers CI timer noise).
+
+    The POSITIVE separation of all three tiers (gather > allreduce > ddp
+    in ms/step) is measured where the collective patterns dominate —
+    tools/bench_strategy_spectrum.py, a 122-leaf comm-bound model on this
+    same 8-virtual-device mesh — and recorded in BASELINE.md ("Strategy
+    cost spectrum"); this test only guards the non-regression direction."""
     import time
 
     import jax.numpy as jnp
