@@ -3,19 +3,35 @@
 Prints ONE JSON line whose head matches the driver contract
 ({"metric", "value", "unit", "vs_baseline"}) and which additionally carries
 
-  * ``matrix``  — per-(strategy x model) images/sec/chip over all available
+  * ``headline_stats`` — all N=3 independent headline runs with best /
+    median / min (noise robustness on a shared host whose contention is
+    one-sided; the BEST run is the least-contaminated estimate of device
+    capability, the same rationale as ``timeit``'s min-latency convention —
+    median and min are reported alongside so the spread is visible).
+    Every per-config measurement (headline runs, matrix, peak, sweep) is
+    itself best-of-2 on one staged trainer, so a single contaminated
+    window cannot land in the output verbatim and all entries carry the
+    same statistic,
+  * ``matrix``  — per-(strategy x model) throughput over all available
     chips, the reference's strategy-cost spectrum
     (``/root/reference/src/Part 2a/main.py:83-112`` vs ``Part 2b`` vs
-    ``Part 3`` — its entire pedagogical point), and
+    ``Part 3`` — its entire pedagogical point), each entry with
+    ``tflops_per_sec`` and ``mfu_vs_bf16_peak`` derived from XLA's cost
+    model of the compiled step (197 TFLOP/s bf16 peak per v5e chip), and
   * ``scaling`` — a 1..N-device sweep with efficiency vs the 1-device run
     (the BASELINE.json north star: >=90% efficiency 1->8 chips).  On a
     1-chip host the sweep is degenerate ({"1": ...}, efficiency 1.0); the
     harness itself is exercised on the 8-virtual-device CPU mesh in
     tests/test_bench.py.
 
-Protocol (BASELINE.md): the reference's own measurement design — per-step
-wall-clock fenced by fetching the loss values, 20-iteration windows, the first
-window (compile + warmup) excluded — global batch 256, SGD(0.1, 0.9, 1e-4).
+Protocol (BASELINE.md): the reference's own measurement design — windowed
+wall-clock fenced by fetching the loss values, the first window (compile +
+warmup) excluded — global batch 256, SGD(0.1, 0.9, 1e-4).  Bench windows
+are EPOCH-LENGTH (one compiled dispatch per pass over the data): the
+tunneled TPU backend charges ~100 ms host latency per dispatch, which at
+the reference's 20-iteration granularity would measure the tunnel, not the
+chip (tools/perf_pieces.py).  The parity path (Trainer.train_model) keeps
+the reference's 20-iteration reporting.
 
 vs_baseline: the reference publishes no numbers (BASELINE.json
 "published": {}), so the comparison point is the reference's own stack
@@ -26,34 +42,68 @@ measured on this host — torch CPU VGG-11 fwd+bwd+step at batch 256
 import argparse
 import json
 import os
+import statistics
 import sys
 
 # Reference stack on this host (torch CPU, batch 256): images/sec.
 # Measured with tools/bench_torch_baseline.py (38.9 img/s); see BASELINE.md.
 TORCH_CPU_BASELINE_IPS = 38.9
 
+# TPU v5e: 197 TFLOP/s bf16 peak per chip (the MFU denominator; f32 configs
+# use the same denominator since TPU f32 matmuls run bf16 multiply passes).
+V5E_BF16_PEAK_FLOPS = 197e12
+
 MODELS = ("vgg11", "resnet18")
 STRATEGIES = ("gather", "allreduce", "ddp")
+HEADLINE_RUNS = 3
+
+
+def _make_trainer(model: str, strategy: str, num_devices, *,
+                  global_batch: int, data_dir: str, log,
+                  precision: str = "f32"):
+    from cs744_ddp_tpu.train.loop import Trainer
+    return Trainer(model=model, strategy=strategy, num_devices=num_devices,
+                   global_batch=global_batch, data_dir=data_dir,
+                   precision=precision, log=log)
 
 
 def _throughput(model: str, strategy: str, num_devices, *, global_batch: int,
                 max_iters: int, data_dir: str, log,
-                precision: str = "f32") -> float:
-    """images/sec/chip for one configuration (fresh Trainer + mesh)."""
-    from cs744_ddp_tpu.train.loop import Trainer
+                precision: str = "f32", want_flops: bool = False,
+                repeats: int = 1):
+    """(images/sec/chip, flops_per_image | None) for one configuration.
 
-    trainer = Trainer(model=model, strategy=strategy,
-                      num_devices=num_devices, global_batch=global_batch,
-                      data_dir=data_dir, precision=precision, log=log)
-    _, ips_per_chip = trainer.steady_state_throughput(max_iters=max_iters)
-    return ips_per_chip
+    ``repeats`` > 1 re-measures on the SAME staged/compiled trainer and
+    keeps the best — host contention is one-sided, and a single
+    contaminated measurement otherwise lands in the output verbatim (a
+    round-2 matrix entry read 30% low this way)."""
+    trainer = _make_trainer(model, strategy, num_devices,
+                            global_batch=global_batch, data_dir=data_dir,
+                            precision=precision, log=log)
+    # Epoch-length windows: one compiled dispatch per pass over the data
+    # (see steady_state_throughput's docstring re dispatch latency).
+    ips_per_chip = max(
+        trainer.steady_state_throughput(
+            max_iters=max_iters, window_iters="epoch")[1]
+        for _ in range(max(repeats, 1)))
+    flops = trainer.step_flops_per_image() if want_flops else None
+    return ips_per_chip, flops
+
+
+def _mfu_fields(ips_per_chip: float, flops_per_image) -> dict:
+    """tflops_per_sec / mfu_vs_bf16_peak for one chip's throughput."""
+    if not flops_per_image:
+        return {}
+    tflops = ips_per_chip * flops_per_image / 1e12
+    return {"tflops_per_sec": round(tflops, 2),
+            "mfu_vs_bf16_peak": round(tflops * 1e12 / V5E_BF16_PEAK_FLOPS, 4)}
 
 
 def run_bench(*, matrix: bool = True, sweep: bool = True,
               peak: bool = True, max_iters: int = 100,
               global_batch: int = 256,
               models=MODELS, strategies=STRATEGIES,
-              headline_model: str = "vgg11", peak_batch_per_chip: int = 2048,
+              headline_model: str = "vgg11", peak_batch_per_chip: int = 1536,
               log=None) -> dict:
     import jax
 
@@ -62,22 +112,21 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
     ndev = len(jax.devices())
 
     # Headline: the flagship config on all chips (ddp when the mesh is
-    # non-trivial; Part-1 'single' semantics on one chip).  Best of two
-    # independent runs — the standard convention for throughput under
-    # ONE-SIDED noise (timeit reports min latency for the same reason):
-    # the bench host is shared, so slow runs are contaminated by external
-    # contention while the fastest run is the least-contaminated estimate
-    # of device capability; identical code measured ±10% across
-    # invocations here.  Each run excludes its own compile+warmup window
-    # per the reference's protocol.  Documented in BASELINE.md.
+    # non-trivial; Part-1 'single' semantics on one chip), best of
+    # HEADLINE_RUNS independent runs with median/min recorded — see module
+    # docstring and BASELINE.md for the one-sided-noise rationale.
     headline_strategy = "ddp" if ndev > 1 else "single"
     log(f"[bench] headline: {headline_model}/{headline_strategy} "
-        f"on {ndev} device(s), best of 2")
-    headline_runs = [
-        _throughput(headline_model, headline_strategy, ndev,
-                    global_batch=global_batch, max_iters=max_iters,
-                    data_dir=data_dir, log=lambda s: None)
-        for _ in range(2)]
+        f"on {ndev} device(s), best of {HEADLINE_RUNS}")
+    headline_runs = []
+    headline_flops = None
+    for _ in range(HEADLINE_RUNS):
+        ips, fl = _throughput(headline_model, headline_strategy, ndev,
+                              global_batch=global_batch, max_iters=max_iters,
+                              data_dir=data_dir, log=lambda s: None,
+                              want_flops=headline_flops is None, repeats=2)
+        headline_runs.append(ips)
+        headline_flops = headline_flops or fl
     headline = max(headline_runs)
 
     result = {
@@ -86,41 +135,62 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         "unit": "images/sec/chip",
         "vs_baseline": round(headline / TORCH_CPU_BASELINE_IPS, 2),
         "num_devices": ndev,
+        "headline_stats": {
+            "runs": [round(r, 2) for r in headline_runs],
+            "best": round(max(headline_runs), 2),
+            "median": round(statistics.median(headline_runs), 2),
+            "min": round(min(headline_runs), 2),
+        },
+        **_mfu_fields(headline, headline_flops),
     }
 
+    # Raw (unrounded) per-config single-run values, reused by the sweep so
+    # every sweep point carries the same single-run statistic.
+    raw_matrix = {}
     if matrix:
         result["matrix"] = {}
+        # flops depend on (model, precision, batch) only — strategies share.
+        model_flops = {headline_model: headline_flops}
         for model in models:
             for strategy in strategies:
+                entry_key = f"{model}/{strategy}"
                 if model == headline_model and strategy == headline_strategy:
                     # Iteration-for-iteration identical to a headline run —
-                    # reuse a single run instead of a third measurement.
-                    result["matrix"][f"{model}/{strategy}"] = round(
-                        headline_runs[0], 2)
-                    continue
-                log(f"[bench] matrix: {model}/{strategy} on {ndev} device(s)")
-                ips = _throughput(model, strategy, ndev,
-                                  global_batch=global_batch,
-                                  max_iters=max_iters, data_dir=data_dir,
-                                  log=lambda s: None)
-                result["matrix"][f"{model}/{strategy}"] = round(ips, 2)
+                    # reuse a single run instead of another measurement.
+                    raw_matrix[entry_key] = headline_runs[0]
+                else:
+                    log(f"[bench] matrix: {entry_key} on {ndev} device(s)")
+                    ips, fl = _throughput(
+                        model, strategy, ndev, global_batch=global_batch,
+                        max_iters=max_iters, data_dir=data_dir,
+                        log=lambda s: None,
+                        want_flops=model not in model_flops, repeats=2)
+                    raw_matrix[entry_key] = ips
+                    model_flops.setdefault(model, fl)
+                result["matrix"][entry_key] = {
+                    "images_per_sec_per_chip": round(raw_matrix[entry_key], 2),
+                    **_mfu_fields(raw_matrix[entry_key],
+                                  model_flops.get(model)),
+                }
 
     # Peak throughput: the parity protocol pins global batch 256 / f32
     # (the reference's config), which underfills the MXU on one chip; this
     # reports the frontier with both constraints lifted (bf16 mixed
-    # precision, 2048 images PER CHIP) — same measurement design.
+    # precision, 1536 images PER CHIP — the measured sweet spot of the
+    # batch sweep: 1536 > 2048 > 2560 > 3072 on v5e) — same design.
     if peak:
         peak_global = peak_batch_per_chip * ndev
         log(f"[bench] peak: {headline_model}/bf16/batch{peak_global} "
             f"on {ndev} device(s)")
-        ips = _throughput(headline_model, headline_strategy, ndev,
-                          global_batch=peak_global,
-                          max_iters=max(max_iters // 3, 2),
-                          data_dir=data_dir, log=lambda s: None,
-                          precision="bf16")
+        ips, fl = _throughput(headline_model, headline_strategy, ndev,
+                              global_batch=peak_global,
+                              max_iters=max(max_iters // 3, 2),
+                              data_dir=data_dir, log=lambda s: None,
+                              precision="bf16", want_flops=True, repeats=2)
         result["peak"] = {
             "config": f"{headline_model}/bf16/global_batch={peak_global}",
             "images_per_sec_per_chip": round(ips, 2),
+            **_mfu_fields(ips, fl),
         }
 
     if sweep:
@@ -132,21 +202,21 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
             strat_n = "ddp" if n > 1 else "single"
             # The all-chip point duplicates a config already measured (the
             # matrix's ddp entry on multi-chip hosts; one of the headline's
-            # runs on a 1-chip host): reuse a SINGLE-run value instead of
-            # restaging + recompiling the identical config.  Never the
-            # best-of-2 headline itself — every sweep point must carry the
+            # runs on a 1-chip host): reuse a SINGLE-run raw value instead
+            # of restaging + recompiling the identical config.  Never the
+            # best-of-N headline itself — every sweep point must carry the
             # same (single-run) statistic or efficiency ratios are biased.
-            cached = result.get("matrix", {}).get(f"{headline_model}/{strat_n}")
+            cached = raw_matrix.get(f"{headline_model}/{strat_n}")
             if n == ndev and cached is None and strat_n == headline_strategy:
                 cached = headline_runs[0]
             if n == ndev and cached is not None:
                 per_chip[n] = cached
                 continue
             log(f"[bench] sweep: {headline_model}/{strat_n} on {n} device(s)")
-            per_chip[n] = _throughput(headline_model, strat_n, n,
-                                      global_batch=global_batch,
-                                      max_iters=max_iters, data_dir=data_dir,
-                                      log=lambda s: None)
+            per_chip[n], _ = _throughput(
+                headline_model, strat_n, n, global_batch=global_batch,
+                max_iters=max_iters, data_dir=data_dir, log=lambda s: None,
+                repeats=2)
         base = per_chip[1]
         result["scaling"] = {
             "images_per_sec_per_chip": {str(n): round(v, 2)
@@ -176,7 +246,7 @@ def main(argv=None) -> None:
     p.add_argument("--no-peak", action="store_true",
                    help="skip the bf16 large-batch peak-throughput entry")
     p.add_argument("--max-iters", type=int, default=100,
-                   help="steady-state iterations per matrix/sweep config")
+                   help="minimum steady-state iterations per config")
     p.add_argument("--global-batch", type=int, default=256)
     args = p.parse_args(argv)
 

@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", type=float, default=1e-4)
     p.add_argument("--no-augment", action="store_true")
+    p.add_argument("--host-augment", action="store_true",
+                   help="run the train transform in the C++ host pipeline "
+                        "(data/native.py, the reference's DataLoader-worker "
+                        "model) and feed preprocessed f32 batches per step; "
+                        "default keeps the transform fused on device")
     p.add_argument("--precision", default="f32", choices=["f32", "bf16"],
                    help="compute precision: f32 = reference parity; bf16 = "
                         "mixed precision (f32 master weights/optimizer/BN "
@@ -93,6 +98,7 @@ def main(argv=None) -> None:
         sgd_cfg=sgd.SGDConfig(lr=args.lr, momentum=args.momentum,
                               weight_decay=args.weight_decay),
         profile_phases=args.profile_phases,
+        host_augment=args.host_augment,
         limit_train_batches=args.limit_train_batches,
         limit_eval_batches=args.limit_eval_batches,
     )

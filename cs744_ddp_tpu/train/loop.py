@@ -93,6 +93,7 @@ class Trainer:
                  seed: int = SEED, augment: bool = True,
                  sgd_cfg: sgd.SGDConfig = sgd.SGDConfig(),
                  profile_phases: bool = False,
+                 host_augment: bool = False,
                  precision: str = "f32",
                  reshuffle_each_epoch: bool = False,
                  limit_train_batches: Optional[int] = None,
@@ -106,6 +107,14 @@ class Trainer:
         self.global_batch = global_batch
         self.log = log
         self.profile_phases = profile_phases
+        # host_augment: the train transform runs in the C++ host pipeline
+        # (data/native.py fl_augment_f32 — the reference's DataLoader-worker
+        # model, Part 1/main.py:96-101) and the step receives preprocessed
+        # f32 batches.  Uses the per-batch dispatch path: host-side per-batch
+        # work is exactly what this mode exists to exercise/measure.  The
+        # default (False) keeps the TPU-first design: uint8 to the device,
+        # transform fused into the compiled step.
+        self.host_augment = host_augment
         # Compute precision: "f32" (reference parity, the default) or "bf16"
         # (mixed precision: f32 master weights/optimizer/BN statistics/loss,
         # bf16 conv+matmul activations — the MXU's native mode).
@@ -179,6 +188,10 @@ class Trainer:
         self.train_window = steplib.make_train_window(
             self.apply_fn, strat, self.mesh, sgd_cfg, augment=augment,
             compute_dtype=compute_dtype)
+        if host_augment:
+            self.train_step_host = steplib.make_train_step(
+                self.apply_fn, strat, self.mesh, sgd_cfg, augment="host",
+                compute_dtype=compute_dtype)
         self.eval_window = steplib.make_eval_window(
             self.apply_fn, self.mesh, compute_dtype=compute_dtype)
         if profile_phases:
@@ -190,6 +203,7 @@ class Trainer:
         self._staged_train = None   # (epoch_images, epoch_labels, tail)
         self._staged_eval = None
         self._warmed_tail_shapes = set()
+        self._warmed_window_shapes = set()
         self.last_epoch_timers: Optional[WindowedTimers] = None
 
     # -- dataset splits (generation-tracked for staging-cache keys) ---------
@@ -230,7 +244,9 @@ class Trainer:
         from ..train.step import maybe_cast
 
         def body(params, bn_state, images, labels):
-            x = maybe_cast(aug.normalize(images), self.compute_dtype)
+            # host_augment feeds preprocessed f32; otherwise normalize here.
+            x = images if self.host_augment else aug.normalize(images)
+            x = maybe_cast(x, self.compute_dtype)
             logits, _ = self.apply_fn(params, bn_state, x, train=True)
             return lax.pmean(cross_entropy(logits, labels), DATA_AXIS)
 
@@ -286,36 +302,42 @@ class Trainer:
                         self._epoch_sharding))
         staged = (full[0], full[1], tail)
         self._staged_train = (cache_key, staged)
-        self._warm_train_windows(staged)
         return staged
 
     def _warm_train_windows(self, staged):
-        """AOT-compile every program shape the epoch will dispatch (full
-        WINDOW, the ragged window, and the ragged tail batch's own step) so
-        mid-epoch compiles never pollute the timers — the windowed analogue
-        of the reference's first-window warmup exclusion."""
-        epoch_images, epoch_labels, tail = staged
+        """AOT-compile the 20-iteration window shapes train_model will
+        dispatch (full WINDOW and the ragged window) so mid-epoch compiles
+        never pollute the timers — the windowed analogue of the reference's
+        first-window warmup exclusion.  Called from train_model, NOT from
+        staging: the bench path stages epochs but dispatches epoch-length
+        windows (whose compile lands in its own excluded warmup window), and
+        would pay these compiles dead.  Idempotent per shape."""
+        epoch_images, epoch_labels, _ = staged
         nbatches = epoch_images.shape[0]
         key = jax.random.PRNGKey(self.seed)
         shapes = {min(WINDOW, nbatches)} if nbatches else set()
         if nbatches % WINDOW:
             shapes.add(nbatches % WINDOW)
         for w in shapes:
+            cache_key = (w, tuple(epoch_images.shape))
+            if cache_key in self._warmed_window_shapes:
+                continue
             self.train_window.lower(
                 self.state, key, epoch_images, epoch_labels, jnp.int32(0),
                 jnp.zeros((w,), jnp.int8)).compile()
+            self._warmed_window_shapes.add(cache_key)
 
     def _warm_tail_step(self, tail) -> None:
         """AOT-compile the tail-shape train step (idempotent per shape) so
         the ragged batch's compile never lands inside a timed iteration.
         Deliberately NOT done at staging time: the bench path stages epochs
         but never trains the tail, and would pay a dead compile."""
-        shape = tuple(tail[0].shape)
-        if shape in self._warmed_tail_shapes:
+        cache_key = (tail[0].shape[0], str(tail[0].dtype))
+        if cache_key in self._warmed_tail_shapes:
             return
         self.train_step.lower(
             self.state, jax.random.PRNGKey(self.seed), *tail).compile()
-        self._warmed_tail_shapes.add(shape)
+        self._warmed_tail_shapes.add(cache_key)
 
     def _stage_eval(self):
         cache_key = self._test_gen
@@ -345,11 +367,13 @@ class Trainer:
         switches to the per-step path, which additionally times a
         forward-only program to report the reference's fwd/bwd split.
         """
-        if self.profile_phases:
+        if self.profile_phases or self.host_augment:
             return self._train_model_per_step(epoch)
         timers = WindowedTimers(self.log)
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
-        epoch_images, epoch_labels, tail = self._stage_train_epoch(epoch)
+        staged = self._stage_train_epoch(epoch)
+        self._warm_train_windows(staged)
+        epoch_images, epoch_labels, tail = staged
         nbatches = epoch_images.shape[0]
         start = 0
         while start < nbatches:
@@ -377,9 +401,14 @@ class Trainer:
         return timers
 
     def _train_model_per_step(self, epoch: int) -> WindowedTimers:
-        """Per-step dispatch path (slow; used for the fwd/bwd phase split)."""
+        """Per-batch dispatch path: the fwd/bwd phase split
+        (``profile_phases``) and/or the host-side augmentation pipeline
+        (``host_augment`` — per-batch host work is the point of that mode,
+        exactly like the reference's DataLoader workers)."""
         timers = WindowedTimers(self.log)
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
+        step_fn = self.train_step_host if self.host_augment \
+            else self.train_step
         self._warm_per_step_tail_shapes()
         for it, (imgs, labs) in enumerate(_shard_batches(
                 self.train_split, self.world, self.global_batch, epoch,
@@ -389,16 +418,21 @@ class Trainer:
                     it >= self.limit_train_batches:
                 break
             step_key = jax.random.fold_in(key, it)
-            x, y = self._put(imgs, labs)
+            if self.host_augment:
+                x, y = self._put_host_augmented(imgs, labs, epoch, it)
+            else:
+                x, y = self._put(imgs, labs)
+            fwd_time = None
+            if self.profile_phases:
+                t0 = time.time()
+                # np.asarray (a real value fetch) is the fence: under the
+                # tunneled TPU backend block_until_ready can return before
+                # the computation finishes — that would time dispatch only.
+                np.asarray(self._fwd_only(
+                    self.state.params, self.state.bn_state, x, y))
+                fwd_time = time.time() - t0
             t0 = time.time()
-            # np.asarray (a real value fetch) is the fence: under the
-            # tunneled TPU backend block_until_ready can return before the
-            # computation finishes, which would time dispatch, not compute.
-            np.asarray(
-                self._fwd_only(self.state.params, self.state.bn_state, x, y))
-            fwd_time = time.time() - t0
-            t0 = time.time()
-            self.state, loss = self.train_step(self.state, step_key, x, y)
+            self.state, loss = step_fn(self.state, step_key, x, y)
             loss = float(loss)  # value fetch = completion fence
             # The fused step contains its own forward; the separately-timed
             # forward-only program is ONLY used to report the reference's
@@ -408,6 +442,23 @@ class Trainer:
             timers.record(loss, step_time, fwd_time)
         self.last_epoch_timers = timers
         return timers
+
+    def _put_host_augmented(self, imgs: np.ndarray, labs: np.ndarray,
+                            epoch: int, it: int):
+        """Run the train transform in the C++ host pipeline and place the
+        resulting f32 batch.  Randomness is a counter-based host stream,
+        deterministic in (seed, epoch, iteration) — the analogue of the
+        device path's fold_in chain (a different stream, same contract)."""
+        if self.augment:
+            rng = np.random.default_rng([self.seed, epoch, it])
+            offs = rng.integers(0, 9, (len(labs), 2), dtype=np.int32)
+            flips = rng.integers(0, 2, (len(labs),), dtype=np.uint8)
+            xh = native.augment(imgs, offs, flips)
+        else:
+            xh = native.normalize(imgs)
+        return (meshlib.put_global(xh, self._batch_sharding),
+                meshlib.put_global(np.asarray(labs, np.int32),
+                                   self._batch_sharding))
 
     def _warm_per_step_tail_shapes(self) -> None:
         """AOT-compile the ragged-tail shapes of the per-step programs.
@@ -425,16 +476,21 @@ class Trainer:
         if not will_train_tail:
             return
         tb = tail_per * self.world
-        x = jax.ShapeDtypeStruct((tb, 32, 32, 3), jnp.uint8,
+        dtype = np.float32 if self.host_augment else np.uint8
+        dtype_name = np.dtype(dtype).name
+        x = jax.ShapeDtypeStruct((tb, 32, 32, 3), dtype,
                                  sharding=self._batch_sharding)
         y = jax.ShapeDtypeStruct((tb,), jnp.int32,
                                  sharding=self._batch_sharding)
         key = jax.random.PRNGKey(self.seed)
-        if (tb, 32, 32, 3) not in self._warmed_tail_shapes:
-            self.train_step.lower(self.state, key, x, y).compile()
-            self._warmed_tail_shapes.add((tb, 32, 32, 3))
-        self._fwd_only.lower(
-            self.state.params, self.state.bn_state, x, y).compile()
+        step_fn = self.train_step_host if self.host_augment \
+            else self.train_step
+        if (tb, dtype_name) not in self._warmed_tail_shapes:
+            step_fn.lower(self.state, key, x, y).compile()
+            self._warmed_tail_shapes.add((tb, dtype_name))
+        if self.profile_phases:
+            self._fwd_only.lower(
+                self.state.params, self.state.bn_state, x, y).compile()
 
     def test_model(self) -> Tuple[float, int, float]:
         """Full-test-set evaluation in one dispatch; prints the reference's
@@ -516,11 +572,47 @@ class Trainer:
 
     # -- benchmarking -------------------------------------------------------
 
-    def steady_state_throughput(self, max_iters: int = 3 * WINDOW
-                                ) -> Tuple[float, float]:
+    def step_flops_per_image(self) -> Optional[float]:
+        """FLOPs per trained image, from XLA's cost model of the compiled
+        per-batch train step (augment + fwd + bwd + sync + SGD — everything
+        the step really runs).  None when the backend offers no cost
+        analysis.  Used by bench.py for tflops/MFU accounting."""
+        x = jax.ShapeDtypeStruct((self.global_batch, 32, 32, 3), jnp.uint8,
+                                 sharding=self._batch_sharding)
+        y = jax.ShapeDtypeStruct((self.global_batch,), jnp.int32,
+                                 sharding=self._batch_sharding)
+        try:
+            comp = self.train_step.lower(
+                self.state, jax.random.PRNGKey(0), x, y).compile()
+            ca = comp.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            flops = float(ca.get("flops", 0.0))
+        except Exception:
+            return None
+        return flops / self.global_batch if flops > 0 else None
+
+    def steady_state_throughput(self, max_iters: int = 3 * WINDOW,
+                                window_iters=None) -> Tuple[float, float]:
         """(images/sec, images/sec/chip) over steady-state iterations,
-        using the reference's measurement design: 20-iter windows, first
-        window (compile+warmup) excluded."""
+        using the reference's measurement design: windowed dispatches, the
+        first window (compile+warmup) excluded.
+
+        ``window_iters`` sets the iterations per compiled dispatch:
+        ``"epoch"`` = the whole epoch per dispatch (what bench.py uses on
+        TPU), an int = that many, None = min(epoch, max(max_iters, WINDOW)).
+        Windows LARGER than the reference's 20-iteration reporting window
+        are deliberate: each dispatch through the tunneled TPU backend
+        costs ~100 ms of host-side latency regardless of size (measured;
+        tools/perf_pieces.py), which at 20-iter windows would measure the
+        tunnel, not the chip (~51k vs ~88k img/s at the headline config).
+        The reference-parity path (train_model) keeps the 20-iteration
+        granularity for its print schedule; documented in BASELINE.md."""
+        if self.host_augment:
+            raise ValueError(
+                "steady_state_throughput measures the compiled windowed "
+                "path (device-side transform); it does not support "
+                "host_augment=True — construct a separate Trainer for "
+                "throughput measurement")
         key = jax.random.PRNGKey(self.seed)
         epoch_images, epoch_labels, _ = self._stage_train_epoch(0)
         nbatches = epoch_images.shape[0]
@@ -529,9 +621,12 @@ class Trainer:
                 "steady_state_throughput needs at least one full global "
                 f"batch ({self.global_batch}); the dataset holds only a "
                 "ragged tail")
-        w = min(WINDOW, nbatches)  # small datasets: clamp the window
+        if window_iters == "epoch":
+            w = nbatches
+        else:
+            w = min(window_iters or max(max_iters, WINDOW), nbatches)
         length_arr = jnp.zeros((w,), jnp.int8)
-        nwin = max(2, max_iters // w)
+        nwin = max(2, -(-max_iters // w))
         starts = [i * w for i in range(max(nbatches // w, 1))] or [0]
 
         # Per-window keys, FOLDED AHEAD OF the timed region: when the start

@@ -39,6 +39,21 @@ def maybe_cast(x: jax.Array, compute_dtype) -> jax.Array:
     return x.astype(compute_dtype) if compute_dtype else x
 
 
+def _prepare(augment, key, images):
+    """The train input transform, by mode:
+
+    ``True``   — device-side pad-crop/flip/normalize (the default: uint8 in,
+                 the whole transform fused into the step's XLA program);
+    ``False``  — device-side normalize only (uint8 in, augmentation off);
+    ``"host"`` — images arrive PREPROCESSED (f32, already augmented and
+                 normalized by the C++ host pipeline, data/native.py — the
+                 reference's DataLoader-worker model); pass through.
+    """
+    if augment == "host":
+        return images
+    return aug.augment(key, images) if augment else aug.normalize(images)
+
+
 class TrainState(NamedTuple):
     params: Any
     bn_state: Any
@@ -61,8 +76,10 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
                     compute_dtype=None) -> Callable:
     """Build the jitted train step.
 
-    step(state, key, images_u8[B,32,32,3], labels[B]) -> (state, loss)
-    with B = global batch, sharded over the mesh's "data" axis.
+    step(state, key, images[B,32,32,3], labels[B]) -> (state, loss)
+    with B = global batch, sharded over the mesh's "data" axis; images are
+    uint8 (``augment`` True/False: transform on device) or preprocessed
+    float32 (``augment="host"`` — see ``_prepare``).
 
     The ``local`` strategy (reference Part 1: single process, no process
     group — ``/root/reference/src/Part 1/main.py``) compiles WITHOUT
@@ -76,8 +93,7 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
 
         @jax.jit
         def single_step(state: TrainState, key, images, labels):
-            x = aug.augment(key, images) if augment else aug.normalize(images)
-            x = maybe_cast(x, compute_dtype)
+            x = maybe_cast(_prepare(augment, key, images), compute_dtype)
 
             def loss_fn(p):
                 logits, new_bn = apply_fn(p, state.bn_state, x, train=True)
@@ -94,8 +110,7 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
     def shard_body(params, bn_state, opt_state, key, images, labels):
         # Distinct augmentation stream per shard, deterministic in (key, pos).
         key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
-        x = aug.augment(key, images) if augment else aug.normalize(images)
-        x = maybe_cast(x, compute_dtype)
+        x = maybe_cast(_prepare(augment, key, images), compute_dtype)
 
         def loss_fn(p):
             logits, new_bn = apply_fn(p, bn_state, x, train=True)
@@ -165,8 +180,7 @@ def make_train_window(apply_fn: Callable,
             k = jax.random.fold_in(key, idx)
             if axis_ok:
                 k = jax.random.fold_in(k, lax.axis_index(DATA_AXIS))
-            x = aug.augment(k, images) if augment else aug.normalize(images)
-            x = maybe_cast(x, compute_dtype)
+            x = maybe_cast(_prepare(augment, k, images), compute_dtype)
 
             def loss_fn(p):
                 logits, new_bn = apply_fn(p, bn_state, x, train=True)

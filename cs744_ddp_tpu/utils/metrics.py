@@ -28,6 +28,9 @@ class WindowedTimers:
         self.forward_time = 0.0
         self.backward_time = 0.0
         self.total_time = 0.0
+        # Full per-iteration loss trajectory (the reference's convergence
+        # oracle, SURVEY.md §4) — what equivalence tests compare.
+        self.losses: List[float] = []
         # Steady-state samples (first window excluded) for throughput calc.
         self.steady_step_times: List[float] = []
         self.steady_forward_times: List[float] = []
@@ -40,6 +43,7 @@ class WindowedTimers:
         'backward' bucket likewise absorbs sync+step, Part 2a/main.py:92-97).
         """
         self.epoch_loss += loss
+        self.losses.append(loss)
         self.total_time += step_time
         warmup = self.iter_number <= WINDOW
         if forward_time is not None:

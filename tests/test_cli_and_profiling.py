@@ -11,6 +11,8 @@ The reference's two reporting/launch surfaces that round 1 left untested:
 
 import numpy as np
 
+import jax
+
 from cs744_ddp_tpu import cli
 from cs744_ddp_tpu.data import cifar10
 from cs744_ddp_tpu.train.loop import Trainer
@@ -50,6 +52,29 @@ def test_profile_phases_reports_fwd_bwd_split(tmp_path, mesh4):
     assert len(timers.steady_forward_times) == 45 - 20
     assert (np.mean(timers.steady_forward_times)
             <= 1.1 * np.mean(timers.steady_step_times))
+
+
+def test_host_augment_trains_deterministically(tmp_path, mesh4):
+    """--host-augment (VERDICT r2 weak #7): the C++ host pipeline feeds
+    preprocessed f32 batches through the per-batch path; training works,
+    converges on the synthetic split, and is run-to-run deterministic."""
+    def run():
+        tr = Trainer(model=tiny_cnn(), strategy="allreduce", mesh=mesh4,
+                     global_batch=64, data_dir=str(tmp_path), augment=True,
+                     host_augment=True, limit_train_batches=25,
+                     log=lambda s: None)
+        timers = tr.train_model(0)
+        return timers.losses, tr.state
+
+    losses_a, state_a = run()
+    losses_b, state_b = run()
+    assert len(losses_a) == 25
+    # Convergence oracle (synthetic data is class-templated).
+    assert np.mean(losses_a[-5:]) < np.mean(losses_a[:5])
+    # Host RNG stream is counter-based in (seed, epoch, it): bitwise rerun.
+    assert losses_a == losses_b
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), state_a.params, state_b.params)
 
 
 def test_profile_phases_honors_reshuffle_and_limit(tmp_path, mesh4):
