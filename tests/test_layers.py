@@ -79,6 +79,29 @@ def test_maxpool_matches_torch():
                                theirs.numpy().transpose(0, 2, 3, 1), atol=1e-6)
 
 
+def test_maxpool_gradient_matches_torch_including_ties():
+    """The custom maxpool backward (block-compare, no select-and-scatter)
+    must route gradient to the FIRST maximal window element like torch —
+    exercised with heavy ties (quantized values and all-equal windows,
+    the post-ReLU all-zeros case)."""
+    rng = np.random.default_rng(7)
+    # Quantize to force frequent within-window ties; add all-zero windows.
+    x = np.round(rng.normal(size=(3, 8, 8, 5)).astype(np.float32) * 2) / 2
+    x[0, :2, :2, :] = 0.0
+    dy = rng.normal(size=(3, 4, 4, 5)).astype(np.float32)
+
+    def loss(a):
+        return jnp.sum(layers.maxpool2x2(a) * jnp.asarray(dy))
+
+    ours = np.asarray(jax.grad(loss)(jnp.asarray(x)))
+
+    tx = torch.from_numpy(x.transpose(0, 3, 1, 2)).requires_grad_(True)
+    ty = nn.MaxPool2d(2, 2)(tx)
+    ty.backward(torch.from_numpy(dy.transpose(0, 3, 1, 2)))
+    theirs = tx.grad.numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_array_equal(ours, theirs)
+
+
 def test_torch_default_init_bounds():
     """Conv/linear init must be U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
     key = jax.random.PRNGKey(0)
