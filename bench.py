@@ -18,10 +18,11 @@ Prints ONE JSON line whose head matches the driver contract
     ``Part 3`` — its entire pedagogical point), each entry with
     ``tflops_per_sec`` and ``mfu_vs_bf16_peak`` derived from XLA's cost
     model of the compiled step (197 TFLOP/s bf16 peak per v5e chip), and
-  * ``scaling`` — a 1..N-device sweep with efficiency vs the 1-device run
-    (the BASELINE.json north star: >=90% efficiency 1->8 chips).  On a
-    1-chip host the sweep is degenerate ({"1": ...}, efficiency 1.0); the
-    harness itself is exercised on the 8-virtual-device CPU mesh in
+  * ``scaling`` — a 1..N-device WEAK-scaling sweep (per-chip batch held
+    constant) with efficiency vs the 1-device run (the BASELINE.json north
+    star: >=90% images/sec/chip efficiency 1->8 chips).  On a 1-chip host
+    the sweep is degenerate ({"1": ...}, efficiency 1.0); the harness
+    itself is exercised on the 8-virtual-device CPU mesh in
     tests/test_bench.py.
 
 Protocol (BASELINE.md): the reference's own measurement design — windowed
@@ -194,31 +195,36 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         }
 
     if sweep:
+        # WEAK scaling: per-chip batch held at ``global_batch`` while the
+        # mesh grows (global = global_batch x n).  The north star is
+        # images/sec/CHIP efficiency (BASELINE.json >=90% at 1->8), which
+        # is a constant-per-chip-work metric: at the reference's fixed
+        # global 256 on 8 chips the per-chip batch would be 32 against a
+        # full 37 MB gradient all-reduce per step — comm-dominated by
+        # construction, measuring the protocol rather than the framework.
+        # The reference's own strong-scaling config (global 256 divided
+        # across workers) is what the MATRIX measures.
         counts = [n for n in (1, 2, 4, 8, 16, 32, 64) if n <= ndev]
         if counts[-1] != ndev:
             counts.append(ndev)
         per_chip = {}
         for n in counts:
             strat_n = "ddp" if n > 1 else "single"
-            # The all-chip point duplicates a config already measured (the
-            # matrix's ddp entry on multi-chip hosts; one of the headline's
-            # runs on a 1-chip host): reuse a SINGLE-run raw value instead
-            # of restaging + recompiling the identical config.  Never the
-            # best-of-N headline itself — every sweep point must carry the
-            # same (single-run) statistic or efficiency ratios are biased.
-            cached = raw_matrix.get(f"{headline_model}/{strat_n}")
-            if n == ndev and cached is None and strat_n == headline_strategy:
-                cached = headline_runs[0]
-            if n == ndev and cached is not None:
-                per_chip[n] = cached
+            # n=1 with per-chip batch == global_batch is exactly a headline
+            # run's config on a 1-chip host: reuse one run's value (same
+            # best-of-2-per-trainer statistic as fresh sweep points).
+            if n == 1 and ndev == 1 and strat_n == headline_strategy:
+                per_chip[n] = headline_runs[0]
                 continue
-            log(f"[bench] sweep: {headline_model}/{strat_n} on {n} device(s)")
+            log(f"[bench] sweep: {headline_model}/{strat_n} on {n} "
+                f"device(s), global batch {global_batch * n}")
             per_chip[n], _ = _throughput(
-                headline_model, strat_n, n, global_batch=global_batch,
+                headline_model, strat_n, n, global_batch=global_batch * n,
                 max_iters=max_iters, data_dir=data_dir, log=lambda s: None,
                 repeats=2)
         base = per_chip[1]
         result["scaling"] = {
+            "protocol": f"weak scaling, {global_batch} images/chip",
             "images_per_sec_per_chip": {str(n): round(v, 2)
                                         for n, v in per_chip.items()},
             "efficiency_vs_1chip": {str(n): round(v / base, 3)

@@ -52,9 +52,11 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     assert result["peak"]["images_per_sec_per_chip"] > 0
     assert "bf16" in result["peak"]["config"]
 
-    # Scaling sweep: 1,2,4,8 devices; efficiency is per-chip relative to
-    # the 1-device run and must be finite/positive; 1-device eff == 1.
+    # Scaling sweep: 1,2,4,8 devices; WEAK scaling (constant per-chip
+    # batch); efficiency is per-chip relative to the 1-device run and must
+    # be finite/positive; 1-device eff == 1.
     sc = result["scaling"]
+    assert sc["protocol"] == "weak scaling, 64 images/chip"
     assert set(sc["images_per_sec_per_chip"]) == {"1", "2", "4", "8"}
     eff = sc["efficiency_vs_1chip"]
     assert eff["1"] == 1.0
