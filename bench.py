@@ -145,9 +145,6 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         **_mfu_fields(headline, headline_flops),
     }
 
-    # Raw (unrounded) per-config single-run values, reused by the sweep so
-    # every sweep point carries the same single-run statistic.
-    raw_matrix = {}
     if matrix:
         result["matrix"] = {}
         # flops depend on (model, precision, batch) only — strategies share.
@@ -157,8 +154,8 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
                 entry_key = f"{model}/{strategy}"
                 if model == headline_model and strategy == headline_strategy:
                     # Iteration-for-iteration identical to a headline run —
-                    # reuse a single run instead of another measurement.
-                    raw_matrix[entry_key] = headline_runs[0]
+                    # reuse one run instead of another measurement.
+                    ips = headline_runs[0]
                 else:
                     log(f"[bench] matrix: {entry_key} on {ndev} device(s)")
                     ips, fl = _throughput(
@@ -166,12 +163,10 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
                         max_iters=max_iters, data_dir=data_dir,
                         log=lambda s: None,
                         want_flops=model not in model_flops, repeats=2)
-                    raw_matrix[entry_key] = ips
                     model_flops.setdefault(model, fl)
                 result["matrix"][entry_key] = {
-                    "images_per_sec_per_chip": round(raw_matrix[entry_key], 2),
-                    **_mfu_fields(raw_matrix[entry_key],
-                                  model_flops.get(model)),
+                    "images_per_sec_per_chip": round(ips, 2),
+                    **_mfu_fields(ips, model_flops.get(model)),
                 }
 
     # Peak throughput: the parity protocol pins global batch 256 / f32

@@ -63,15 +63,34 @@ class CheckpointManager:
         )
         if config is not None and not os.path.exists(self._config_path) \
                 and jax.process_index() == 0:
-            # Atomic write (unique temp + rename) from process 0 only:
-            # concurrent writers (two runs racing on one dir) or a crash
-            # mid-write must never leave a torn config that the guard above
-            # would choke on; the pid suffix keeps racing writers off each
-            # other's temp files so the rename source is always complete.
+            # Publish the config ATOMICALLY AND EXCLUSIVELY from process 0:
+            # write a complete unique temp file (crash mid-write can never
+            # leave a torn trainer_config.json), then hard-link it into
+            # place — link fails with FileExistsError if another run won
+            # the race, in which case the loser VALIDATES against the
+            # winner instead of silently overwriting it (two different
+            # configs racing one empty dir must not end with one of them
+            # misidentified).
             tmp = f"{self._config_path}.{os.getpid()}.tmp"
             with open(tmp, "w") as f:
                 json.dump(config, f)
-            os.replace(tmp, self._config_path)
+            try:
+                os.link(tmp, self._config_path)
+            except FileExistsError:
+                with open(self._config_path) as f:
+                    existing = json.load(f)
+                if existing != config:
+                    raise ValueError(
+                        f"checkpoint dir {directory} was concurrently "
+                        f"claimed by a different training config: "
+                        f"saved={existing}, current={config}")
+            except OSError:
+                # Filesystem without hard links: fall back to an atomic
+                # (but last-writer-wins) rename.
+                os.replace(tmp, self._config_path)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
 
     def latest_epoch(self) -> Optional[int]:
         """Last COMPLETED epoch saved, or None if no checkpoint exists."""

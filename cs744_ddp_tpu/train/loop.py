@@ -488,9 +488,11 @@ class Trainer:
         if (tb, dtype_name) not in self._warmed_tail_shapes:
             step_fn.lower(self.state, key, x, y).compile()
             self._warmed_tail_shapes.add((tb, dtype_name))
-        if self.profile_phases:
+        if self.profile_phases and \
+                ("fwd", tb, dtype_name) not in self._warmed_tail_shapes:
             self._fwd_only.lower(
                 self.state.params, self.state.bn_state, x, y).compile()
+            self._warmed_tail_shapes.add(("fwd", tb, dtype_name))
 
     def test_model(self) -> Tuple[float, int, float]:
         """Full-test-set evaluation in one dispatch; prints the reference's
@@ -576,7 +578,13 @@ class Trainer:
         """FLOPs per trained image, from XLA's cost model of the compiled
         per-batch train step (augment + fwd + bwd + sync + SGD — everything
         the step really runs).  None when the backend offers no cost
-        analysis.  Used by bench.py for tflops/MFU accounting."""
+        analysis.  Used by bench.py for tflops/MFU accounting.
+
+        ``cost_analysis()`` reports the PER-DEVICE SPMD partition, which
+        processes global_batch/world images — so the divisor is the
+        per-device batch, not the global batch (verified on the 8-virtual-
+        device mesh: per-device flops are ~world x smaller than the
+        1-device program's for the same global batch)."""
         x = jax.ShapeDtypeStruct((self.global_batch, 32, 32, 3), jnp.uint8,
                                  sharding=self._batch_sharding)
         y = jax.ShapeDtypeStruct((self.global_batch,), jnp.int32,
@@ -589,7 +597,8 @@ class Trainer:
             flops = float(ca.get("flops", 0.0))
         except Exception:
             return None
-        return flops / self.global_batch if flops > 0 else None
+        per_device_batch = self.global_batch // self.world
+        return flops / per_device_batch if flops > 0 else None
 
     def steady_state_throughput(self, max_iters: int = 3 * WINDOW,
                                 window_iters=None) -> Tuple[float, float]:

@@ -80,10 +80,12 @@ def test_maxpool_matches_torch():
 
 
 def test_maxpool_gradient_matches_torch_including_ties():
-    """The custom maxpool backward (block-compare, no select-and-scatter)
-    must route gradient to the FIRST maximal window element like torch —
-    exercised with heavy ties (quantized values and all-equal windows,
-    the post-ReLU all-zeros case)."""
+    """maxpool2x2's backward (XLA's native select-and-scatter — the
+    deliberately-kept implementation, see the layers.py docstring for the
+    measured negative results of replacing it) must route gradient to the
+    FIRST maximal window element like torch — exercised with heavy ties
+    (quantized values and all-equal windows, the post-ReLU all-zeros
+    case)."""
     rng = np.random.default_rng(7)
     # Quantize to force frequent within-window ties; add all-zero windows.
     x = np.round(rng.normal(size=(3, 8, 8, 5)).astype(np.float32) * 2) / 2
