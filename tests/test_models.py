@@ -97,6 +97,99 @@ def test_vgg11_forward_matches_torch_with_transplanted_weights():
     np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
 
 
+def torch_resnet18_cifar():
+    """The standard CIFAR ResNet-18 (3x3 stem, no maxpool, 10-class head)
+    rebuilt in torch, mirroring models/resnet.py's architecture spec."""
+
+    class Block(nn.Module):
+        def __init__(self, cin, cout, stride):
+            super().__init__()
+            self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(cout)
+            self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(cout)
+            self.down = None
+            if stride != 1 or cin != cout:
+                self.down = nn.Sequential(
+                    nn.Conv2d(cin, cout, 1, stride, 0, bias=False),
+                    nn.BatchNorm2d(cout))
+
+        def forward(self, x):
+            y = torch.relu(self.bn1(self.conv1(x)))
+            y = self.bn2(self.conv2(y))
+            sc = self.down(x) if self.down is not None else x
+            return torch.relu(y + sc)
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.stem_conv = nn.Conv2d(3, 64, 3, 1, 1, bias=False)
+            self.stem_bn = nn.BatchNorm2d(64)
+            blocks, cin = [], 64
+            for width, stage_stride in ((64, 1), (128, 2), (256, 2),
+                                        (512, 2)):
+                for b in range(2):
+                    blocks.append(Block(cin, width,
+                                        stage_stride if b == 0 else 1))
+                    cin = width
+            self.blocks = nn.ModuleList(blocks)
+            self.fc = nn.Linear(512, 10)
+
+        def forward(self, x):
+            y = torch.relu(self.stem_bn(self.stem_conv(x)))
+            for blk in self.blocks:
+                y = blk(y)
+            y = y.mean(dim=(2, 3))
+            return self.fc(y)
+
+    return Net()
+
+
+def _conv_w(c):
+    return jnp.asarray(c.weight.detach().numpy().transpose(2, 3, 1, 0))
+
+
+def _bn_p(b):
+    return ({"gamma": jnp.asarray(b.weight.detach().numpy()),
+             "beta": jnp.asarray(b.bias.detach().numpy())},
+            {"mean": jnp.asarray(b.running_mean.numpy()),
+             "var": jnp.asarray(b.running_var.numpy())})
+
+
+def test_resnet18_forward_matches_torch_with_transplanted_weights():
+    """Transplant a torch CIFAR-ResNet-18's weights into our pytree; logits
+    must agree — the full-model forward parity VGG already has
+    (residual adds, strided downsampling, global average pool included)."""
+    torch.manual_seed(0)
+    tmodel = torch_resnet18_cifar().eval()
+    params, state = resnet.init(jax.random.PRNGKey(0))
+
+    params["stem_conv"] = {"w": _conv_w(tmodel.stem_conv)}
+    params["stem_bn"], state["stem_bn"] = _bn_p(tmodel.stem_bn)
+    for i, blk in enumerate(tmodel.blocks):
+        bp, bs = params["blocks"][i], state["blocks"][i]
+        bp["conv1"] = {"w": _conv_w(blk.conv1)}
+        bp["bn1"], bs["bn1"] = _bn_p(blk.bn1)
+        bp["conv2"] = {"w": _conv_w(blk.conv2)}
+        bp["bn2"], bs["bn2"] = _bn_p(blk.bn2)
+        if blk.down is not None:
+            bp["down_conv"] = {"w": _conv_w(blk.down[0])}
+            bp["down_bn"], bs["down_bn"] = _bn_p(blk.down[1])
+        else:
+            assert "down_conv" not in bp  # architecture agreement
+    params["fc"] = {"w": jnp.asarray(tmodel.fc.weight.detach().numpy().T),
+                    "b": jnp.asarray(tmodel.fc.bias.detach().numpy())}
+
+    x = np.random.default_rng(1).normal(size=(4, 32, 32, 3)).astype(np.float32)
+    ours, _ = resnet.apply(params, state, jnp.asarray(x), train=False)
+    theirs = tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2))).detach().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
+
+    # Same count, leaf for leaf (transplant covered every parameter).
+    torch_count = sum(p.numel() for p in tmodel.parameters())
+    assert n_params(params) == torch_count
+
+
 def test_resnet18_shapes_and_count():
     params, state = resnet.init(jax.random.PRNGKey(0))
     # CIFAR ResNet-18 (3x3 stem, 10-class head): 11,173,962 params.
