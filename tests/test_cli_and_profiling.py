@@ -77,6 +77,21 @@ def test_host_augment_trains_deterministically(tmp_path, mesh4):
         np.asarray(a), np.asarray(b)), state_a.params, state_b.params)
 
 
+def test_host_augment_trains_the_ragged_tail(tmp_path, mesh4):
+    """host_augment's per-batch path must train the short final batch too
+    (f32 tail shapes flow through _warm_per_step_tail_shapes and the host
+    pipeline): 200 examples / world 4 / batch 64 -> per-rank 50 = 3*16 + 2,
+    i.e. 3 full batches plus a ragged global tail of 8."""
+    tr = Trainer(model=tiny_cnn(), strategy="allreduce", mesh=mesh4,
+                 global_batch=64, data_dir=str(tmp_path), augment=True,
+                 host_augment=True, log=lambda s: None)
+    tr.train_split = cifar10.Split(tr.train_split.images[:200],
+                                   tr.train_split.labels[:200])
+    timers = tr.train_model(0)
+    assert timers.iter_number - 1 == 4  # ceil(50 / 16)
+    assert all(np.isfinite(l) for l in timers.losses)
+
+
 def test_profile_phases_honors_reshuffle_and_limit(tmp_path, mesh4):
     """The per-step path must forward reshuffle_each_epoch (ADVICE r1) and
     respect limit_train_batches."""
