@@ -104,7 +104,8 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
               peak: bool = True, max_iters: int = 100,
               global_batch: int = 256,
               models=MODELS, strategies=STRATEGIES,
-              headline_model: str = "vgg11", peak_batch_per_chip: int = 1536,
+              headline_model: str = "vgg11",
+              peak_batch_candidates=(1536, 2048),
               log=None) -> dict:
     import jax
 
@@ -172,22 +173,32 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
     # Peak throughput: the parity protocol pins global batch 256 / f32
     # (the reference's config), which underfills the MXU on one chip; this
     # reports the frontier with both constraints lifted (bf16 mixed
-    # precision, 1536 images PER CHIP — the measured sweet spot of the
-    # batch sweep: 1536 > 2048 > 2560 > 3072 on v5e) — same design.
+    # precision, large per-chip batch) — same measurement design.  The
+    # frontier is a SEARCH over the two best measured batch candidates
+    # (1536 then 2048 images/chip; the day-long sweep measured
+    # 1536 > 2048 > 2560 > 3072 on v5e, within a couple % of each other),
+    # reporting the winning config — which also shields the headline peak
+    # from a single moment of host contention.
     if peak:
-        peak_global = peak_batch_per_chip * ndev
-        log(f"[bench] peak: {headline_model}/bf16/batch{peak_global} "
-            f"on {ndev} device(s)")
-        ips, fl = _throughput(headline_model, headline_strategy, ndev,
-                              global_batch=peak_global,
-                              max_iters=max(max_iters // 3, 2),
-                              data_dir=data_dir, log=lambda s: None,
-                              precision="bf16", want_flops=True, repeats=2)
-        result["peak"] = {
-            "config": f"{headline_model}/bf16/global_batch={peak_global}",
-            "images_per_sec_per_chip": round(ips, 2),
-            **_mfu_fields(ips, fl),
-        }
+        best = None
+        for per_chip_batch in dict.fromkeys(peak_batch_candidates):
+            peak_global = per_chip_batch * ndev
+            log(f"[bench] peak: {headline_model}/bf16/batch{peak_global} "
+                f"on {ndev} device(s)")
+            ips, fl = _throughput(
+                headline_model, headline_strategy, ndev,
+                global_batch=peak_global, max_iters=max(max_iters // 3, 2),
+                data_dir=data_dir, log=lambda s: None,
+                precision="bf16", want_flops=True, repeats=2)
+            cand = {
+                "config": f"{headline_model}/bf16/"
+                          f"global_batch={peak_global}",
+                "images_per_sec_per_chip": round(ips, 2),
+                **_mfu_fields(ips, fl),
+            }
+            if best is None or ips > best["images_per_sec_per_chip"]:
+                best = cand
+        result["peak"] = best
 
     if sweep:
         # WEAK scaling: per-chip batch held at ``global_batch`` while the

@@ -29,7 +29,8 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     result = bench.run_bench(matrix=True, sweep=True, max_iters=8,
                              global_batch=64, models=("tiny",),
                              strategies=("allreduce", "ddp"),
-                             headline_model="tiny", peak_batch_per_chip=16,
+                             headline_model="tiny",
+                             peak_batch_candidates=(8, 16),
                              log=lambda s: None)
     # Driver contract head.
     assert result["metric"] == "cifar10_tiny_images_per_sec_per_chip"
