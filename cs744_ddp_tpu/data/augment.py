@@ -57,10 +57,16 @@ def augment(key: jax.Array, images_u8: jax.Array) -> jax.Array:
     TPU-native formulation: the per-example crop/flip is expressed as two
     batched ONE-HOT MATMULS (row-select, then column-select with the flip
     folded into the column one-hot), so the whole augmentation rides the MXU
-    instead of lowering to per-example gathers (which serialize on TPU).
-    One-hot selection sums pick exactly one term, and uint8 values (<=255)
-    are exact in bfloat16, so the result is bit-identical to the gather
-    formulation (pinned by tests/test_data.py).
+    instead of lowering to per-example gathers.  One-hot selection sums pick
+    exactly one term, and uint8 values (<=255) are exact in bfloat16, so the
+    result is bit-identical to the gather formulation (tests/test_data.py).
+
+    Round-3 negative result: a ``take_along_axis`` (gather) variant
+    microbenchmarked ~25% cheaper in a standalone scan, but measured ~5%
+    SLOWER for the WHOLE train step in A/B (83-85k vs 88-89k img/s at the
+    headline config) — in-step, XLA fuses the one-hot matmuls with their
+    neighbors better than the gathers.  Standalone microbenchmarks of
+    fusion-sensitive ops mislead on TPU; A/B the full step.
 
     Per-example randomness comes from a single fold of the step key —
     deterministic given (seed, step), independent of device count.
