@@ -77,7 +77,7 @@ def _throughput(model: str, strategy: str, num_devices, *, global_batch: int,
     ``repeats`` > 1 re-measures on the SAME staged/compiled trainer and
     keeps the best — host contention is one-sided, and a single
     contaminated measurement otherwise lands in the output verbatim (a
-    round-2 matrix entry read 30% low this way)."""
+    round-3 trial's matrix entry read 30% low this way)."""
     trainer = _make_trainer(model, strategy, num_devices,
                             global_batch=global_batch, data_dir=data_dir,
                             precision=precision, log=log)
