@@ -39,7 +39,8 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["single", "gather", "allreduce", "ddp"],
                    help="gradient sync strategy: Part 1/2a/2b/3 equivalents")
     p.add_argument("--model", default="vgg11",
-                   choices=["vgg11", "vgg13", "vgg16", "vgg19", "resnet18"])
+                   choices=["vgg11", "vgg13", "vgg16", "vgg19",
+                            "resnet18", "resnet34"])
     p.add_argument("--batch-size", type=int, default=GLOBAL_BATCH,
                    help="GLOBAL batch (divided across workers, as in the "
                         "reference: Part 2a/main.py:22)")

@@ -1,4 +1,4 @@
-"""Model zoo: VGG-11/13/16/19 (reference parity) + ResNet-18 (stress config)."""
+"""Model zoo: VGG-11/13/16/19 (reference parity) + ResNet-18/34 (stress)."""
 
 from . import resnet, vgg
 
@@ -25,6 +25,8 @@ def get_model(name: str):
     if name in ("vgg11", "vgg13", "vgg16", "vgg19"):
         return vgg.make(name.upper())
     if name in ("resnet18", "resnet-18"):
-        return resnet.make()
+        return resnet.make("ResNet18")
+    if name in ("resnet34", "resnet-34"):
+        return resnet.make("ResNet34")
     raise ValueError(f"unknown model {name!r}; expected vgg11/13/16/19, "
-                     f"resnet18, or one of {sorted(_CUSTOM) or '(none)'}")
+                     f"resnet18/34, or one of {sorted(_CUSTOM) or '(none)'}")

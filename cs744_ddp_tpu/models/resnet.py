@@ -1,10 +1,12 @@
-"""ResNet-18 (CIFAR-10 variant) — the scaling stress config.
+"""ResNet-18/34 (CIFAR-10 variants) — the scaling stress configs.
 
 BASELINE.json config #5 calls for "ResNet-18 / CIFAR-10 8-worker allreduce
 (scaling stress beyond coursework)".  This is the standard CIFAR-adapted
-ResNet-18: a 3x3 stem (no 7x7/maxpool — inputs are 32x32), four stages of two
-BasicBlocks at widths (64,128,256,512) with strides (1,2,2,2), global average
-pool, Linear(512,10).  Same functional (init, apply) contract as models.vgg.
+BasicBlock ResNet: a 3x3 stem (no 7x7/maxpool — inputs are 32x32), four
+stages of BasicBlocks at widths (64,128,256,512) with strides (1,2,2,2),
+global average pool, Linear(512,10).  ResNet-18 has (2,2,2,2) blocks per
+stage; ResNet-34 has (3,4,6,3) — the next rung of the same family for
+deeper stress runs.  Same functional (init, apply) contract as models.vgg.
 """
 
 from __future__ import annotations
@@ -17,7 +19,7 @@ import jax.numpy as jnp
 from . import layers
 
 STAGES = ((64, 1), (128, 2), (256, 2), (512, 2))
-BLOCKS_PER_STAGE = 2
+BLOCK_COUNTS = {"ResNet18": (2, 2, 2, 2), "ResNet34": (3, 4, 6, 3)}
 NUM_CLASSES = 10
 
 
@@ -51,7 +53,9 @@ def _block_apply(p, s, x, stride, *, train):
     return layers.relu(y + sc), ns
 
 
-def init(key: jax.Array, dtype=jnp.float32) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+def init(key: jax.Array, name: str = "ResNet18",
+         dtype=jnp.float32) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    counts = BLOCK_COUNTS[name]
     key, sub = jax.random.split(key)
     params: Dict[str, Any] = {
         "stem_conv": layers.conv2d_init(sub, 3, 64, 3, dtype, bias=False)}
@@ -60,8 +64,8 @@ def init(key: jax.Array, dtype=jnp.float32) -> Tuple[Dict[str, Any], Dict[str, A
 
     in_ch = 64
     blocks_p, blocks_s = [], []
-    for width, stage_stride in STAGES:
-        for b in range(BLOCKS_PER_STAGE):
+    for (width, stage_stride), nblocks in zip(STAGES, counts):
+        for b in range(nblocks):
             stride = stage_stride if b == 0 else 1
             key, sub = jax.random.split(key)
             bp, bs = _block_init(sub, in_ch, width, stride, dtype)
@@ -76,9 +80,10 @@ def init(key: jax.Array, dtype=jnp.float32) -> Tuple[Dict[str, Any], Dict[str, A
     return params, state
 
 
-def apply(params, state, x: jax.Array, *,
-          train: bool) -> Tuple[jax.Array, Dict[str, Any]]:
+def apply(params, state, x: jax.Array, *, train: bool,
+          name: str = "ResNet18") -> Tuple[jax.Array, Dict[str, Any]]:
     """x: [N,32,32,3] -> logits [N,10], new state."""
+    counts = BLOCK_COUNTS[name]
     new_state: Dict[str, Any] = {}
     y = layers.conv2d_apply(params["stem_conv"], x, stride=1, padding=1)
     y, new_state["stem_bn"] = layers.batchnorm_apply(
@@ -87,8 +92,8 @@ def apply(params, state, x: jax.Array, *,
 
     new_blocks = []
     i = 0
-    for width, stage_stride in STAGES:
-        for b in range(BLOCKS_PER_STAGE):
+    for (width, stage_stride), nblocks in zip(STAGES, counts):
+        for b in range(nblocks):
             stride = stage_stride if b == 0 else 1
             y, ns = _block_apply(params["blocks"][i], state["blocks"][i], y,
                                  stride, train=train)
@@ -101,9 +106,19 @@ def apply(params, state, x: jax.Array, *,
     return logits, new_state
 
 
-def make():
-    return init, lambda p, s, x, *, train: apply(p, s, x, train=train)
+def make(name: str = "ResNet18"):
+    def init_fn(key, dtype=jnp.float32):
+        return init(key, name, dtype)
+
+    def apply_fn(p, s, x, *, train):
+        return apply(p, s, x, train=train, name=name)
+
+    return init_fn, apply_fn
 
 
 def ResNet18():
-    return make()
+    return make("ResNet18")
+
+
+def ResNet34():
+    return make("ResNet34")

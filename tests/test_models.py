@@ -97,9 +97,10 @@ def test_vgg11_forward_matches_torch_with_transplanted_weights():
     np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
 
 
-def torch_resnet18_cifar():
-    """The standard CIFAR ResNet-18 (3x3 stem, no maxpool, 10-class head)
-    rebuilt in torch, mirroring models/resnet.py's architecture spec."""
+def torch_resnet_cifar(counts=(2, 2, 2, 2)):
+    """The standard CIFAR BasicBlock ResNet (3x3 stem, no maxpool, 10-class
+    head) rebuilt in torch, mirroring models/resnet.py's architecture spec;
+    ``counts`` are blocks per stage ((2,2,2,2)=18, (3,4,6,3)=34)."""
 
     class Block(nn.Module):
         def __init__(self, cin, cout, stride):
@@ -126,9 +127,9 @@ def torch_resnet18_cifar():
             self.stem_conv = nn.Conv2d(3, 64, 3, 1, 1, bias=False)
             self.stem_bn = nn.BatchNorm2d(64)
             blocks, cin = [], 64
-            for width, stage_stride in ((64, 1), (128, 2), (256, 2),
-                                        (512, 2)):
-                for b in range(2):
+            for (width, stage_stride), nblocks in zip(
+                    ((64, 1), (128, 2), (256, 2), (512, 2)), counts):
+                for b in range(nblocks):
                     blocks.append(Block(cin, width,
                                         stage_stride if b == 0 else 1))
                     cin = width
@@ -156,13 +157,15 @@ def _bn_p(b):
              "var": jnp.asarray(b.running_var.numpy())})
 
 
-def test_resnet18_forward_matches_torch_with_transplanted_weights():
-    """Transplant a torch CIFAR-ResNet-18's weights into our pytree; logits
+@pytest.mark.parametrize("name,counts", [("ResNet18", (2, 2, 2, 2)),
+                                         ("ResNet34", (3, 4, 6, 3))])
+def test_resnet_forward_matches_torch_with_transplanted_weights(name, counts):
+    """Transplant a torch CIFAR-ResNet's weights into our pytree; logits
     must agree — the full-model forward parity VGG already has
     (residual adds, strided downsampling, global average pool included)."""
     torch.manual_seed(0)
-    tmodel = torch_resnet18_cifar().eval()
-    params, state = resnet.init(jax.random.PRNGKey(0))
+    tmodel = torch_resnet_cifar(counts).eval()
+    params, state = resnet.init(jax.random.PRNGKey(0), name)
 
     params["stem_conv"] = {"w": _conv_w(tmodel.stem_conv)}
     params["stem_bn"], state["stem_bn"] = _bn_p(tmodel.stem_bn)
@@ -181,7 +184,8 @@ def test_resnet18_forward_matches_torch_with_transplanted_weights():
                     "b": jnp.asarray(tmodel.fc.bias.detach().numpy())}
 
     x = np.random.default_rng(1).normal(size=(4, 32, 32, 3)).astype(np.float32)
-    ours, _ = resnet.apply(params, state, jnp.asarray(x), train=False)
+    ours, _ = resnet.apply(params, state, jnp.asarray(x), train=False,
+                           name=name)
     theirs = tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2))).detach().numpy()
     np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
 
@@ -200,7 +204,7 @@ def test_resnet18_shapes_and_count():
 
 
 def test_get_model_registry():
-    for name in ("vgg11", "vgg16", "resnet18"):
+    for name in ("vgg11", "vgg16", "resnet18", "resnet34"):
         init_fn, apply_fn = get_model(name)
         params, state = init_fn(jax.random.PRNGKey(1))
         logits, _ = apply_fn(params, state, jnp.zeros((1, 32, 32, 3)),

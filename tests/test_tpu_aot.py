@@ -103,14 +103,16 @@ def test_gather_strategy_keeps_two_phase_shape_on_tpu(v5e8_mesh):
 
 
 def test_large_zoo_models_compile_for_v5e8(v5e8_mesh):
-    """vgg19 (16 BNs) and resnet18 (20 BNs) must compile for the 8-chip
-    TPU topology.  Regression lock for the post-main-fusion SIGILL: every
-    model beyond vgg11 crashed the v5e compiler until the BN backward's
-    fusion fence (models/layers.py::_bn_train_bwd) — vgg11-only coverage
-    let that ship."""
+    """vgg19 (16 BNs), resnet18 (20 BNs) and resnet34 (36 BNs) must compile
+    for the 8-chip TPU topology.  Regression lock for the post-main-fusion
+    SIGILL: every model beyond vgg11 crashed the v5e compiler until the BN
+    backward's fusion fence (models/layers.py::_bn_train_bwd) — vgg11-only
+    coverage let that ship."""
     from cs744_ddp_tpu.models import resnet
 
     txt = _compile_step(v5e8_mesh, vgg.VGG19(), "ddp", 64)
     assert " all-reduce(" in txt
     txt = _compile_step(v5e8_mesh, resnet.ResNet18(), "ddp", 64)
+    assert " all-reduce(" in txt
+    txt = _compile_step(v5e8_mesh, resnet.ResNet34(), "ddp", 64)
     assert " all-reduce(" in txt
