@@ -20,10 +20,18 @@ Prints ONE JSON line whose head matches the driver contract
     model of the compiled step (197 TFLOP/s bf16 peak per v5e chip), and
   * ``scaling`` — a 1..N-device WEAK-scaling sweep (per-chip batch held
     constant) with efficiency vs the 1-device run (the BASELINE.json north
-    star: >=90% images/sec/chip efficiency 1->8 chips).  On a 1-chip host
-    the sweep is degenerate ({"1": ...}, efficiency 1.0); the harness
-    itself is exercised on the 8-virtual-device CPU mesh in
-    tests/test_bench.py.
+    star: >=90% images/sec/chip efficiency 1->8 chips) and per-point MFU,
+    plus a ``strong`` sub-section measuring the reference's own protocol
+    (global batch 256 divided across workers).  On a 1-chip host the sweep
+    is degenerate ({"1": ...}, efficiency 1.0); the harness itself is
+    exercised on the 8-virtual-device CPU mesh in tests/test_bench.py,
+  * ``convergence`` — the reference's correctness oracle (1-epoch test
+    accuracy, ``Part 1/main.py:74-76``) on the active dataset, labeled
+    ``real_data`` false when the synthetic fallback is in use (this host
+    has no egress; see BASELINE.md), and
+  * ``spectrum`` — static per-strategy collective counts and comm bytes
+    from the TPU v5e-8 AOT lowering (the strategy tiers' cost shapes,
+    independent of wall-clock noise).
 
 Protocol (BASELINE.md): the reference's own measurement design — windowed
 wall-clock fenced by fetching the loss values, the first window (compile +
@@ -71,13 +79,16 @@ def _make_trainer(model: str, strategy: str, num_devices, *,
 def _throughput(model: str, strategy: str, num_devices, *, global_batch: int,
                 max_iters: int, data_dir: str, log,
                 precision: str = "f32", want_flops: bool = False,
-                repeats: int = 1):
+                repeats: int = 1, flops_log=None):
     """(images/sec/chip, flops_per_image | None) for one configuration.
 
     ``repeats`` > 1 re-measures on the SAME staged/compiled trainer and
     keeps the best — host contention is one-sided, and a single
     contaminated measurement otherwise lands in the output verbatim (a
-    round-3 trial's matrix entry read 30% low this way)."""
+    round-3 trial's matrix entry read 30% low this way).
+
+    ``flops_log`` receives the MFU-unavailable reason (the trainer's own
+    ``log`` is suppressed in bench runs to mute the print schedule)."""
     trainer = _make_trainer(model, strategy, num_devices,
                             global_batch=global_batch, data_dir=data_dir,
                             precision=precision, log=log)
@@ -87,7 +98,7 @@ def _throughput(model: str, strategy: str, num_devices, *, global_batch: int,
         trainer.steady_state_throughput(
             max_iters=max_iters, window_iters="epoch")[1]
         for _ in range(max(repeats, 1)))
-    flops = trainer.step_flops_per_image() if want_flops else None
+    flops = trainer.step_flops_per_image(log=flops_log) if want_flops else None
     return ips_per_chip, flops
 
 
@@ -100,8 +111,82 @@ def _mfu_fields(ips_per_chip: float, flops_per_image) -> dict:
             "mfu_vs_bf16_peak": round(tflops * 1e12 / V5E_BF16_PEAK_FLOPS, 4)}
 
 
+def _collect_spectrum(log, model: str, global_batch: int):
+    """Static per-strategy collective stats from the TPU v5e-8 AOT lowering
+    (deviceless topology — compiles anywhere the TPU compiler is present).
+
+    This is the strategy-cost spectrum as the COMPILER sees it: collective
+    instruction counts and result-buffer bytes per tier, immune to host
+    noise.  None (with a logged reason) where the TPU AOT client is
+    unavailable."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from cs744_ddp_tpu import models as model_zoo
+    from cs744_ddp_tpu.ops import sgd as sgdlib
+    from cs744_ddp_tpu.parallel import get_strategy
+    from cs744_ddp_tpu.parallel.mesh import DATA_AXIS
+    from cs744_ddp_tpu.train import step as steplib
+    from cs744_ddp_tpu.utils.hlo_stats import collective_stats
+
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc("v5e:2x4", platform="tpu")
+    except Exception as e:
+        log(f"[bench] spectrum: TPU AOT topology unavailable ({e!r}); "
+            "section omitted")
+        return None
+    # The lowering shards the batch 8 ways regardless of how many devices
+    # the measurement host has; keep it divisible.
+    global_batch = -(-global_batch // 8) * 8
+    mesh = Mesh(np.array(topo.devices), (DATA_AXIS,))
+    init_fn, apply_fn = model_zoo.get_model(model)
+    state = steplib.init_train_state(init_fn, jax.random.PRNGKey(0))
+    rep = NamedSharding(mesh, P())
+    sh = NamedSharding(mesh, P(DATA_AXIS))
+    state_sds = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep), state)
+    args = (state_sds,
+            jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep),
+            jax.ShapeDtypeStruct((global_batch, 32, 32, 3), jnp.uint8,
+                                 sharding=sh),
+            jax.ShapeDtypeStruct((global_batch,), jnp.int32, sharding=sh))
+    grad_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                     for a in jax.tree.leaves(state.params))
+    out = {
+        "topology": "v5e:2x4 (AOT, deviceless)",
+        "model": model, "global_batch": global_batch,
+        "grad_mib": round(grad_bytes / 2**20, 2),
+        "note": "result_mib sums collective RESULT buffers: all-gather's "
+                "is world x its input, so the gather tier's world-times "
+                "traffic amplification (vs the reference's root-link "
+                "gather, Part 2a/main.py:117-127) is explicit — see "
+                "BASELINE.md 'Gather-tier traffic accounting'",
+        "per_strategy": {},
+    }
+    for name in ("gather", "allreduce", "ddp"):
+        log(f"[bench] spectrum: AOT-compiling {model}/{name} for v5e-8")
+        try:
+            step = steplib.make_train_step(
+                apply_fn, get_strategy(name), mesh, sgdlib.SGDConfig(),
+                augment=True)
+            txt = step.lower(*args).compile().as_text()
+        except Exception as e:
+            # Never let the static section kill a bench whose expensive
+            # measurements already completed — omit it with the reason.
+            log(f"[bench] spectrum: AOT compile failed for {name} "
+                f"({e!r}); section omitted")
+            return None
+        out["per_strategy"][name] = collective_stats(txt)
+    return out
+
+
 def run_bench(*, matrix: bool = True, sweep: bool = True,
-              peak: bool = True, max_iters: int = 100,
+              peak: bool = True, convergence: bool = True,
+              spectrum: bool = True,
+              max_iters: int = 100,
               global_batch: int = 256,
               models=MODELS, strategies=STRATEGIES,
               headline_model: str = "vgg11",
@@ -126,7 +211,8 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         ips, fl = _throughput(headline_model, headline_strategy, ndev,
                               global_batch=global_batch, max_iters=max_iters,
                               data_dir=data_dir, log=lambda s: None,
-                              want_flops=headline_flops is None, repeats=2)
+                              want_flops=headline_flops is None, repeats=2,
+                              flops_log=log)
         headline_runs.append(ips)
         headline_flops = headline_flops or fl
     headline = max(headline_runs)
@@ -146,6 +232,36 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         **_mfu_fields(headline, headline_flops),
     }
 
+    # Convergence oracle — the reference's own correctness signal (1-epoch
+    # test accuracy, /root/reference/src/Part 1/main.py:74-76), tracked per
+    # round so the artifact carries it, not just a test assertion.  On this
+    # egress-less bench host the dataset is the deterministic synthetic
+    # fallback (real_data=false, labels derived from image statistics —
+    # learnable, so the accuracy still moves well above the 10% chance
+    # floor); real-CIFAR accuracy remains unverifiable here (BASELINE.md).
+    if convergence:
+        log(f"[bench] convergence: {headline_model}/{headline_strategy}, "
+            "1 epoch @ reference config")
+        trainer = _make_trainer(headline_model, headline_strategy, ndev,
+                                global_batch=global_batch, data_dir=data_dir,
+                                log=lambda s: None)
+        timers = trainer.train_model(0)
+        avg_loss, correct, acc = trainer.test_model()
+        result["convergence"] = {
+            "protocol": "1 epoch, reference config (global batch "
+                        f"{global_batch}, SGD 0.1/0.9/1e-4, f32)",
+            "train_loss_first": round(timers.losses[0], 4),
+            "train_loss_last": round(timers.losses[-1], 4),
+            "test_avg_loss": round(avg_loss, 4),
+            "test_accuracy_pct": round(acc, 2),
+            "real_data": trainer.real_data,
+        }
+
+    if spectrum:
+        spec = _collect_spectrum(log, headline_model, global_batch)
+        if spec is not None:
+            result["spectrum"] = spec
+
     if matrix:
         result["matrix"] = {}
         # flops depend on (model, precision, batch) only — strategies share.
@@ -163,7 +279,8 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
                         model, strategy, ndev, global_batch=global_batch,
                         max_iters=max_iters, data_dir=data_dir,
                         log=lambda s: None,
-                        want_flops=model not in model_flops, repeats=2)
+                        want_flops=model not in model_flops, repeats=2,
+                        flops_log=log)
                     model_flops.setdefault(model, fl)
                 result["matrix"][entry_key] = {
                     "images_per_sec_per_chip": round(ips, 2),
@@ -180,7 +297,7 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
     # reporting the winning config — which also shields the headline peak
     # from a single moment of host contention.
     if peak:
-        best = None
+        best, best_ips = None, None
         for per_chip_batch in dict.fromkeys(peak_batch_candidates):
             peak_global = per_chip_batch * ndev
             log(f"[bench] peak: {headline_model}/bf16/batch{peak_global} "
@@ -189,15 +306,19 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
                 headline_model, headline_strategy, ndev,
                 global_batch=peak_global, max_iters=max(max_iters // 3, 2),
                 data_dir=data_dir, log=lambda s: None,
-                precision="bf16", want_flops=True, repeats=2)
-            cand = {
-                "config": f"{headline_model}/bf16/"
-                          f"global_batch={peak_global}",
-                "images_per_sec_per_chip": round(ips, 2),
-                **_mfu_fields(ips, fl),
-            }
-            if best is None or ips > best["images_per_sec_per_chip"]:
-                best = cand
+                precision="bf16", want_flops=True, repeats=2,
+                flops_log=log)
+            # Compare UNROUNDED ips (the stored value is rounded; a
+            # near-tie within the rounding step could otherwise pick a
+            # candidate inconsistent with the reported numbers).
+            if best_ips is None or ips > best_ips:
+                best_ips = ips
+                best = {
+                    "config": f"{headline_model}/bf16/"
+                              f"global_batch={peak_global}",
+                    "images_per_sec_per_chip": round(ips, 2),
+                    **_mfu_fields(ips, fl),
+                }
         result["peak"] = best
 
     if sweep:
@@ -213,7 +334,7 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         counts = [n for n in (1, 2, 4, 8, 16, 32, 64) if n <= ndev]
         if counts[-1] != ndev:
             counts.append(ndev)
-        per_chip = {}
+        per_chip, sweep_flops = {}, {}
         for n in counts:
             strat_n = "ddp" if n > 1 else "single"
             # n=1 with per-chip batch == global_batch is exactly a headline
@@ -221,13 +342,14 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
             # best-of-2-per-trainer statistic as fresh sweep points).
             if n == 1 and ndev == 1 and strat_n == headline_strategy:
                 per_chip[n] = headline_runs[0]
+                sweep_flops[n] = headline_flops
                 continue
             log(f"[bench] sweep: {headline_model}/{strat_n} on {n} "
                 f"device(s), global batch {global_batch * n}")
-            per_chip[n], _ = _throughput(
+            per_chip[n], sweep_flops[n] = _throughput(
                 headline_model, strat_n, n, global_batch=global_batch * n,
                 max_iters=max_iters, data_dir=data_dir, log=lambda s: None,
-                repeats=2)
+                repeats=2, want_flops=True, flops_log=log)
         base = per_chip[1]
         result["scaling"] = {
             "protocol": f"weak scaling, {global_batch} images/chip",
@@ -235,6 +357,38 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
                                         for n, v in per_chip.items()},
             "efficiency_vs_1chip": {str(n): round(v / base, 3)
                                     for n, v in per_chip.items()},
+            "mfu_vs_bf16_peak": {
+                str(n): _mfu_fields(v, sweep_flops[n]).get("mfu_vs_bf16_peak")
+                for n, v in per_chip.items()},
+        }
+
+        # STRONG scaling — the reference's own protocol (global batch 256
+        # DIVIDED across workers, Part 2a/main.py:22): the per-chip batch
+        # shrinks as the mesh grows, so comm exposure rises by construction
+        # (BASELINE.md "Scaling protocol").  Reported alongside the weak
+        # sweep so both protocols are on the record; efficiency is
+        # global-throughput(n) / (n x global-throughput(1)), which reduces
+        # to the same per-chip ratio as the weak formula.
+        strong_counts = [n for n in counts if global_batch % n == 0]
+        strong = {}
+        for n in strong_counts:
+            strat_n = "ddp" if n > 1 else "single"
+            if n == 1 and 1 in per_chip:
+                strong[n] = per_chip[1]   # identical config: reuse
+                continue
+            log(f"[bench] sweep(strong): {headline_model}/{strat_n} on {n} "
+                f"device(s), global batch {global_batch}")
+            strong[n], _ = _throughput(
+                headline_model, strat_n, n, global_batch=global_batch,
+                max_iters=max_iters, data_dir=data_dir, log=lambda s: None,
+                repeats=2)
+        result["scaling"]["strong"] = {
+            "protocol": f"strong scaling, global batch {global_batch} "
+                        "(the reference's config)",
+            "images_per_sec": {str(n): round(v * n, 2)
+                               for n, v in strong.items()},
+            "efficiency_vs_1chip": {str(n): round(v / strong[1], 3)
+                                    for n, v in strong.items()},
         }
     return result
 
@@ -252,11 +406,18 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser("bench")
     p.add_argument("--no-matrix", action="store_true",
                    help="headline metric only (fast driver mode; also "
-                        "skips the peak entry)")
+                        "skips the peak, convergence and spectrum "
+                        "sections)")
     p.add_argument("--no-sweep", action="store_true",
                    help="skip the 1..N-device scaling sweep")
     p.add_argument("--no-peak", action="store_true",
                    help="skip the bf16 large-batch peak-throughput entry")
+    p.add_argument("--no-convergence", action="store_true",
+                   help="skip the 1-epoch accuracy (convergence oracle) "
+                        "entry")
+    p.add_argument("--no-spectrum", action="store_true",
+                   help="skip the static per-strategy collective-stats "
+                        "section (v5e-8 AOT lowering)")
     p.add_argument("--max-iters", type=int, default=100,
                    help="minimum steady-state iterations per config")
     p.add_argument("--global-batch", type=int, default=256)
@@ -265,6 +426,9 @@ def main(argv=None) -> None:
     _enable_compilation_cache()
     result = run_bench(matrix=not args.no_matrix, sweep=not args.no_sweep,
                        peak=not (args.no_peak or args.no_matrix),
+                       convergence=not (args.no_convergence
+                                        or args.no_matrix),
+                       spectrum=not (args.no_spectrum or args.no_matrix),
                        max_iters=args.max_iters,
                        global_batch=args.global_batch)
     print(json.dumps(result))

@@ -27,6 +27,13 @@ import orbax.checkpoint as ocp
 
 from .step import TrainState
 
+# Bumped whenever the on-disk TrainState pytree STRUCTURE changes (e.g. an
+# optimizer-state field added/removed): old checkpoints cannot be restored
+# across such changes, and without this stamp the failure is orbax's opaque
+# structure error (or a config-digest mismatch that doesn't say WHY).
+# History: 1 = SGDState carried a step counter; 2 = it doesn't.
+STATE_FORMAT_VERSION = 2
+
 
 class CheckpointManager:
     """Thin orbax CheckpointManager wrapper keyed on completed epochs.
@@ -42,6 +49,9 @@ class CheckpointManager:
                  config: Optional[dict] = None):
         directory = os.path.abspath(directory)
         self._config_path = os.path.join(directory, "trainer_config.json")
+        if config is not None:
+            config = {**config,
+                      "state_format_version": STATE_FORMAT_VERSION}
         if config is not None and os.path.exists(self._config_path):
             with open(self._config_path) as f:
                 try:
@@ -52,6 +62,14 @@ class CheckpointManager:
                         f"trainer_config.json ({e}); refusing to resume from "
                         f"an unidentifiable run — delete the directory to "
                         f"start fresh") from e
+            saved_ver = existing.get("state_format_version")
+            if saved_ver != STATE_FORMAT_VERSION:
+                raise ValueError(
+                    f"checkpoint dir {directory} holds state-format version "
+                    f"{saved_ver}, but this build writes version "
+                    f"{STATE_FORMAT_VERSION}; checkpoints do not survive "
+                    f"TrainState structure changes — delete the directory "
+                    f"to start fresh")
             if existing != config:
                 raise ValueError(
                     f"checkpoint dir {directory} belongs to a different "

@@ -22,6 +22,9 @@ Differences from the reference, by design (all documented in BASELINE.md):
 
 from __future__ import annotations
 
+import itertools
+import queue
+import threading
 import time
 from typing import Callable, Iterator, Optional, Tuple
 
@@ -396,7 +399,9 @@ class Trainer:
             t0 = time.time()
             self.state, loss = self.train_step(self.state, tail_key, *tail)
             loss = float(loss)  # value fetch = completion fence
-            timers.record(loss, time.time() - t0)
+            # steady=False: this lone per-dispatch sample carries the fixed
+            # dispatch latency the amortized window samples do not.
+            timers.record(loss, time.time() - t0, steady=False)
         self.last_epoch_timers = timers
         return timers
 
@@ -404,24 +409,26 @@ class Trainer:
         """Per-batch dispatch path: the fwd/bwd phase split
         (``profile_phases``) and/or the host-side augmentation pipeline
         (``host_augment`` — per-batch host work is the point of that mode,
-        exactly like the reference's DataLoader workers)."""
+        exactly like the reference's DataLoader workers, so it is
+        double-buffered the way theirs is: batch k+1 prepares on a
+        producer thread while step k runs, ``_iter_host_batches``)."""
         timers = WindowedTimers(self.log)
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
         step_fn = self.train_step_host if self.host_augment \
             else self.train_step
         self._warm_per_step_tail_shapes()
-        for it, (imgs, labs) in enumerate(_shard_batches(
-                self.train_split, self.world, self.global_batch, epoch,
-                shuffle=True, seed=self.seed,
-                reshuffle_each_epoch=self.reshuffle_each_epoch)):
-            if self.limit_train_batches is not None and \
-                    it >= self.limit_train_batches:
-                break
+        if self.host_augment:
+            batches = self._iter_host_batches(epoch)
+        else:
+            batches = ((it, *self._put(imgs, labs))
+                       for it, (imgs, labs) in enumerate(_shard_batches(
+                           self.train_split, self.world, self.global_batch,
+                           epoch, shuffle=True, seed=self.seed,
+                           reshuffle_each_epoch=self.reshuffle_each_epoch)))
+            if self.limit_train_batches is not None:
+                batches = itertools.islice(batches, self.limit_train_batches)
+        for it, x, y in batches:
             step_key = jax.random.fold_in(key, it)
-            if self.host_augment:
-                x, y = self._put_host_augmented(imgs, labs, epoch, it)
-            else:
-                x, y = self._put(imgs, labs)
             fwd_time = None
             if self.profile_phases:
                 t0 = time.time()
@@ -459,6 +466,67 @@ class Trainer:
         return (meshlib.put_global(xh, self._batch_sharding),
                 meshlib.put_global(np.asarray(labs, np.int32),
                                    self._batch_sharding))
+
+    # Prefetched batches queued ahead of the consumer: 2 = one in flight on
+    # the producer thread plus one ready — the reference's num_workers=2
+    # DataLoader keeps the same depth of completed batches ahead.
+    PREFETCH_DEPTH = 2
+
+    def _iter_host_batches(self, epoch: int):
+        """Double-buffered host-augment pipeline: yields ``(it, x, y)`` with
+        batch k+1 gathered, C++-augmented and device-put on a producer
+        thread while step k runs on device — the reference's
+        DataLoader-worker overlap (``Part 1/main.py:96-101``), which the
+        previously-serial per-step path lacked (VERDICT r3 item 6).
+
+        The host RNG stream is counter-based in (seed, epoch, it)
+        (``_put_host_augmented``), so the prefetched stream is
+        BIT-IDENTICAL to the serial one regardless of thread timing —
+        pinned by tests/test_cli_and_profiling.py."""
+        q: queue.Queue = queue.Queue(maxsize=self.PREFETCH_DEPTH)
+        stop = threading.Event()
+
+        def safe_put(item) -> bool:
+            """Enqueue unless the consumer has gone away."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for it, (imgs, labs) in enumerate(_shard_batches(
+                        self.train_split, self.world, self.global_batch,
+                        epoch, shuffle=True, seed=self.seed,
+                        reshuffle_each_epoch=self.reshuffle_each_epoch)):
+                    if self.limit_train_batches is not None and \
+                            it >= self.limit_train_batches:
+                        break
+                    item = (it, *self._put_host_augmented(
+                        imgs, labs, epoch, it))
+                    if not safe_put(("item", item)):
+                        return
+                safe_put(("done", None))
+            except Exception as e:   # surfaced in the consumer
+                safe_put(("err", e))
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="host-augment-prefetch")
+        t.start()
+        try:
+            while True:
+                kind, payload = q.get()
+                if kind == "done":
+                    break
+                if kind == "err":
+                    raise payload
+                yield payload
+        finally:
+            stop.set()
+            t.join(timeout=10)
 
     def _warm_per_step_tail_shapes(self) -> None:
         """AOT-compile the ragged-tail shapes of the per-step programs.
@@ -574,31 +642,46 @@ class Trainer:
 
     # -- benchmarking -------------------------------------------------------
 
-    def step_flops_per_image(self) -> Optional[float]:
+    def step_flops_per_image(self, log: Optional[Callable[[str], None]] = None
+                             ) -> Optional[float]:
         """FLOPs per trained image, from XLA's cost model of the compiled
         per-batch train step (augment + fwd + bwd + sync + SGD — everything
         the step really runs).  None when the backend offers no cost
-        analysis.  Used by bench.py for tflops/MFU accounting.
+        analysis — the reason is logged (``log`` overrides the trainer's
+        logger, which bench.py suppresses for the print schedule).
+        Used by bench.py for tflops/MFU accounting.
 
         ``cost_analysis()`` reports the PER-DEVICE SPMD partition, which
         processes global_batch/world images — so the divisor is the
         per-device batch, not the global batch (verified on the 8-virtual-
         device mesh: per-device flops are ~world x smaller than the
         1-device program's for the same global batch)."""
+        log = log or self.log
         x = jax.ShapeDtypeStruct((self.global_batch, 32, 32, 3), jnp.uint8,
                                  sharding=self._batch_sharding)
         y = jax.ShapeDtypeStruct((self.global_batch,), jnp.int32,
                                  sharding=self._batch_sharding)
+        # Compile errors propagate: this is the same program the trainer
+        # runs, so a failure here is a real bug, not a missing cost model.
+        comp = self.train_step.lower(
+            self.state, jax.random.PRNGKey(0), x, y).compile()
         try:
-            comp = self.train_step.lower(
-                self.state, jax.random.PRNGKey(0), x, y).compile()
             ca = comp.cost_analysis()
-            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-            flops = float(ca.get("flops", 0.0))
-        except Exception:
+        except (NotImplementedError, RuntimeError) as e:
+            # RuntimeError covers XlaRuntimeError(UNIMPLEMENTED) — the
+            # backends-without-cost-analysis case.  Say why MFU is absent
+            # instead of silently dropping every MFU field from the bench.
+            log(f"MFU accounting unavailable: cost_analysis() failed "
+                f"on this backend: {e!r}")
+            return None
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        flops = float(ca.get("flops", 0.0)) if ca else 0.0
+        if flops <= 0:
+            log("MFU accounting unavailable: cost model reported "
+                f"flops={flops} for the compiled train step")
             return None
         per_device_batch = self.global_batch // self.world
-        return flops / per_device_batch if flops > 0 else None
+        return flops / per_device_batch
 
     def steady_state_throughput(self, max_iters: int = 3 * WINDOW,
                                 window_iters=None) -> Tuple[float, float]:

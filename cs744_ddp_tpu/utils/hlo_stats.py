@@ -1,0 +1,84 @@
+"""Collective-op statistics parsed from compiled HLO text.
+
+Feeds bench.py's ``spectrum`` section (VERDICT r3 items 3b/7): per-strategy
+collective instruction counts and result-buffer bytes from the TPU v5e-8
+AOT lowering — a static, wall-clock-noise-free record of each gradient-sync
+tier's cost shape.  The reference's tiers differ exactly here: Part 2a pays
+two sequential collectives per leaf with world x gather traffic
+(``/root/reference/src/Part 2a/main.py:117-127``), Part 2b one all-reduce
+per leaf (``Part 2b/main.py:116-119``), Part 3 a few fused bucket reduces
+(``Part 3/main.py:61``).
+
+Byte accounting convention: for every collective instruction we sum the
+RESULT buffer sizes (tuple elements included).  For an all-reduce that is
+the reduced tensor's size; for an all-gather it is world x the input — the
+world-times-larger result is precisely the gather tier's traffic
+amplification, so the numbers surface the fidelity question VERDICT item 7
+asks about (symmetric all_gather vs the reference's root-link bottleneck;
+see BASELINE.md "Gather-tier traffic accounting").  Async pairs are counted
+once: the ``-start`` op contributes the instance count (its result tuple
+also holds source buffers, which would overcount bytes), the ``-done`` op
+contributes the result bytes.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+# `%name = <result-type> <collective-op>(...)`; -start before the bare op
+# name so the alternation matches the longest form.
+_COLL_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%[\w.\-]+\s*=\s*(?P<type>.+?)\s+"
+    r"(?P<op>all-reduce-start|all-reduce-done|all-reduce"
+    r"|all-gather-start|all-gather-done|all-gather"
+    r"|reduce-scatter-start|reduce-scatter-done|reduce-scatter"
+    r"|collective-permute-start|collective-permute-done|collective-permute"
+    r"|all-to-all-start|all-to-all-done|all-to-all)\(")
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def bytes_of_type(type_str: str) -> int:
+    """Total bytes of every ``dtype[dims]`` shape in an HLO result type
+    (a bare shape or a tuple; layout/tiling annotations are ignored)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        if dtype not in _DTYPE_BYTES:
+            continue  # e.g. token[] / opaque[]
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_stats(hlo_text: str) -> Dict:
+    """{"ops": {op: {"count", "result_mib"}}, "total_count",
+    "total_result_mib"} over every collective instruction in the module."""
+    ops: Dict[str, Dict[str, float]] = {}
+    for line in hlo_text.splitlines():
+        m = _COLL_RE.match(line)
+        if not m:
+            continue
+        op = m.group("op")
+        base = re.sub(r"-(start|done)$", "", op)
+        entry = ops.setdefault(base, {"count": 0, "result_mib": 0.0})
+        if not op.endswith("-done"):
+            entry["count"] += 1
+        if not op.endswith("-start"):
+            entry["result_mib"] += bytes_of_type(m.group("type")) / 2**20
+    for entry in ops.values():
+        entry["result_mib"] = round(entry["result_mib"], 2)
+    return {
+        "ops": ops,
+        "total_count": sum(e["count"] for e in ops.values()),
+        "total_result_mib": round(
+            sum(e["result_mib"] for e in ops.values()), 2),
+    }

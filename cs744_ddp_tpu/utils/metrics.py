@@ -36,11 +36,18 @@ class WindowedTimers:
         self.steady_forward_times: List[float] = []
 
     def record(self, loss: float, step_time: float,
-               forward_time: Optional[float] = None) -> None:
+               forward_time: Optional[float] = None, *,
+               steady: bool = True) -> None:
         """Record one iteration. ``forward_time`` is optional because the
         functional step is a single fused program; when the trainer runs the
         split-phase timing mode it supplies both phases (the reference's
         'backward' bucket likewise absorbs sync+step, Part 2a/main.py:92-97).
+
+        ``steady=False`` keeps the sample in the print schedule and epoch
+        totals but OUT of the steady-state stats — used for the windowed
+        path's ragged tail, whose lone per-dispatch sample carries ~100 ms
+        of tunnel latency that the amortized per-window samples do not
+        (one outlier per epoch would skew the derived throughput).
         """
         self.epoch_loss += loss
         self.losses.append(loss)
@@ -49,9 +56,9 @@ class WindowedTimers:
         if forward_time is not None:
             self.forward_time += forward_time
             self.backward_time += step_time - forward_time
-            if not warmup:
+            if not warmup and steady:
                 self.steady_forward_times.append(forward_time)
-        if not warmup:
+        if not warmup and steady:
             self.steady_step_times.append(step_time)
 
         if self.iter_number % WINDOW == 0:

@@ -53,6 +53,13 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     assert result["peak"]["images_per_sec_per_chip"] > 0
     assert "bf16" in result["peak"]["config"]
 
+    # Convergence oracle: 1-epoch accuracy on the active (synthetic here)
+    # dataset — the reference's own correctness signal, tracked per round.
+    conv = result["convergence"]
+    assert conv["real_data"] is False   # tmp_path has no CIFAR pickles
+    assert 0.0 <= conv["test_accuracy_pct"] <= 100.0
+    assert conv["test_avg_loss"] > 0
+
     # Scaling sweep: 1,2,4,8 devices; WEAK scaling (constant per-chip
     # batch); efficiency is per-chip relative to the 1-device run and must
     # be finite/positive; 1-device eff == 1.
@@ -62,6 +69,28 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     eff = sc["efficiency_vs_1chip"]
     assert eff["1"] == 1.0
     assert all(v > 0 for v in eff.values())
+    assert set(sc["mfu_vs_bf16_peak"]) == {"1", "2", "4", "8"}
+
+    # Strong scaling: the reference's own protocol (global batch fixed),
+    # reported alongside weak (ADVICE r3 item 4).
+    st = sc["strong"]
+    assert set(st["images_per_sec"]) == {"1", "2", "4", "8"}
+    assert st["efficiency_vs_1chip"]["1"] == 1.0
+    assert all(v > 0 for v in st["efficiency_vs_1chip"].values())
+
+    # Spectrum: static collective stats from the v5e-8 AOT lowering (may be
+    # absent only where the TPU AOT client is unavailable).
+    if "spectrum" in result:
+        per = result["spectrum"]["per_strategy"]
+        assert set(per) == {"gather", "allreduce", "ddp"}
+        # The tiers' cost shapes, exactly as strategies.py constructs them:
+        # gather pays an all-gather per leaf; allreduce strictly more
+        # collectives than ddp (fusion); gather's result bytes amplified by
+        # world x vs the reduced tensors.
+        assert per["gather"]["ops"]["all-gather"]["count"] >= 1
+        assert per["allreduce"]["total_count"] > per["ddp"]["total_count"]
+        assert per["gather"]["total_result_mib"] > \
+            per["allreduce"]["total_result_mib"]
 
     # JSON-serializable single line (the driver contract).
     import json

@@ -1,0 +1,78 @@
+"""POSITIVE strategy-spectrum separation in CI (VERDICT r3 item 3a).
+
+The reference's entire pedagogical point is the ordering
+gather (Part 2a) > allreduce (Part 2b) > ddp (Part 3) in per-step cost
+(``/root/reference/src/Part 2a/main.py:117-127`` vs ``Part 2b/main.py:
+116-119`` vs ``Part 3/main.py:61``).  tests/test_strategies.py pins the
+structural distinction (HLO patterns) and a one-directional bound (ddp must
+not lose); this test asserts the POSITIVE wall-clock separation, so a
+regression that equalized the tiers — e.g. a barrier-chain change letting
+XLA's all-reduce combiner merge the per-param tier — fails CI.
+
+Measured where the collective patterns dominate: the comm-bound MLP from
+tools/bench_strategy_spectrum.py (17M params over 122 leaves, 1 example per
+device) on the 8-virtual-device CPU mesh.  Recorded medians (BASELINE.md):
+gather 3,110 > allreduce 2,068 > ddp 1,430 ms/step — the asserted margins
+(1.15x and 1.05x) sit far inside the measured 1.5x / 1.45x gaps.  Rounds
+are INTERLEAVED across tiers so one-sided host contention (the only noise
+source here) lands on every tier, not one.
+"""
+
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+from bench_strategy_spectrum import mlp_apply, mlp_init  # noqa: E402
+
+from cs744_ddp_tpu.ops import sgd
+from cs744_ddp_tpu.parallel import get_strategy, mesh as meshlib
+from cs744_ddp_tpu.train import step as steplib
+
+ROUNDS = 3
+STEPS_PER_ROUND = 2
+
+
+def test_spectrum_ordering_gather_allreduce_ddp(mesh8):
+    state = steplib.init_train_state(mlp_init, jax.random.PRNGKey(0))
+    state = meshlib.put_global_tree(state, meshlib.replicated(mesh8))
+
+    batch = 8  # 1 example/device: per-step cost ~ the collective pattern
+    rng = np.random.default_rng(0)
+    images = jax.device_put(
+        rng.integers(0, 256, (batch, 32, 32, 3)).astype(np.uint8),
+        meshlib.batch_sharding(mesh8))
+    labels = jax.device_put(
+        rng.integers(0, 10, (batch,)).astype(np.int32),
+        meshlib.batch_sharding(mesh8))
+    key = jax.random.PRNGKey(1)
+
+    steps, states = {}, {}
+    for name in ("gather", "allreduce", "ddp"):
+        steps[name] = steplib.make_train_step(
+            mlp_apply, get_strategy(name), mesh8, sgd.SGDConfig(),
+            augment=False)
+        s, loss = steps[name](state, key, images, labels)  # compile+warmup
+        float(loss)
+        states[name] = s
+
+    samples = {name: [] for name in steps}
+    for _ in range(ROUNDS):
+        for name, step in steps.items():   # interleaved: contention is
+            s = states[name]               # shared across tiers per round
+            t0 = time.time()
+            for _ in range(STEPS_PER_ROUND):
+                s, loss = step(s, key, images, labels)
+            float(loss)                    # value fetch = completion fence
+            samples[name].append((time.time() - t0) / STEPS_PER_ROUND)
+            states[name] = s
+
+    med = {name: statistics.median(v) for name, v in samples.items()}
+    assert med["gather"] > 1.15 * med["allreduce"], med
+    assert med["allreduce"] > 1.05 * med["ddp"], med
