@@ -54,6 +54,22 @@ def _prepare(augment, key, images):
     return aug.augment(key, images) if augment else aug.normalize(images)
 
 
+def fold_and_prepare(augment, compute_dtype, key, images, *, idx=None,
+                     fold_axis=True):
+    """The ONE definition of the train input path's PRNG fold order and
+    transform: fold the batch index first (when the caller passes one —
+    the per-step path folds it on the host instead), the mesh position
+    second, then prepare + cast.  Shared by the fused step, the train
+    window and the forward-only window so the streams cannot drift apart
+    (the phase split's validity depends on the forward window consuming
+    bit-identical inputs to the train window)."""
+    if idx is not None:
+        key = jax.random.fold_in(key, idx)
+    if fold_axis:
+        key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+    return maybe_cast(_prepare(augment, key, images), compute_dtype)
+
+
 class TrainState(NamedTuple):
     params: Any
     bn_state: Any
@@ -93,7 +109,8 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
 
         @jax.jit
         def single_step(state: TrainState, key, images, labels):
-            x = maybe_cast(_prepare(augment, key, images), compute_dtype)
+            x = fold_and_prepare(augment, compute_dtype, key, images,
+                                 fold_axis=False)
 
             def loss_fn(p):
                 logits, new_bn = apply_fn(p, state.bn_state, x, train=True)
@@ -108,9 +125,9 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
         return single_step
 
     def shard_body(params, bn_state, opt_state, key, images, labels):
-        # Distinct augmentation stream per shard, deterministic in (key, pos).
-        key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
-        x = maybe_cast(_prepare(augment, key, images), compute_dtype)
+        # Distinct augmentation stream per shard, deterministic in (key, pos);
+        # the batch index is folded on the host by the per-step caller.
+        x = fold_and_prepare(augment, compute_dtype, key, images)
 
         def loss_fn(p):
             logits, new_bn = apply_fn(p, bn_state, x, train=True)
@@ -172,15 +189,13 @@ def make_train_window(apply_fn: Callable,
         def one(carry, xs):
             params, bn_state, opt_state, key = carry
             images, labels, idx = xs
-            # Canonical fold order across ALL execution paths: batch index
-            # first, mesh position second — the per-step path folds the
-            # iteration on the host (loop.py) and the position in
-            # make_train_step, so with the same order here the windowed and
+            # Canonical fold order across ALL execution paths (see
+            # fold_and_prepare): batch index first, mesh position second —
+            # the per-step path folds the iteration on the host (loop.py)
+            # and the position in make_train_step, so the windowed and
             # per-step paths consume identical augmentation streams.
-            k = jax.random.fold_in(key, idx)
-            if axis_ok:
-                k = jax.random.fold_in(k, lax.axis_index(DATA_AXIS))
-            x = maybe_cast(_prepare(augment, k, images), compute_dtype)
+            x = fold_and_prepare(augment, compute_dtype, key, images,
+                                 idx=idx, fold_axis=axis_ok)
 
             def loss_fn(p):
                 logits, new_bn = apply_fn(p, bn_state, x, train=True)
@@ -248,6 +263,63 @@ def make_train_window(apply_fn: Callable,
         return TrainState(p, bn, opt), losses
 
     return window
+
+
+def make_fwd_window(apply_fn: Callable, mesh: Mesh, *, single: bool = False,
+                    augment: bool = True, compute_dtype=None) -> Callable:
+    """Forward-only analogue of ``make_train_window``: W augment+forward+
+    loss iterations per dispatch via ``lax.scan``, same PRNG fold order and
+    train=True BN semantics as the fused step, no backward/update.
+
+    Exists for the reference's fwd/bwd phase split
+    (``/root/reference/src/Part 1/main.py:33-43``) measured HONESTLY on the
+    tunneled TPU backend: per-dispatch timing pays ~100 ms of host latency
+    that dwarfs the 0.6 ms forward, so the split must be window-amortized
+    (``Trainer.measure_phase_split``) — backward ≈ train-window − fwd-window
+    per iteration, with the dispatch cost amortized to noise."""
+
+    def fwd_body(params, bn_state, key, epoch_images, epoch_labels, start,
+                 length_arr):
+        w = length_arr.shape[0]
+        imgs = lax.dynamic_slice_in_dim(epoch_images, start, w, axis=0)
+        labs = lax.dynamic_slice_in_dim(epoch_labels, start, w, axis=0)
+        idxs = start + jnp.arange(w, dtype=jnp.int32)
+
+        def one(carry, xs):
+            images, labels, idx = xs
+            x = fold_and_prepare(augment, compute_dtype, key, images,
+                                 idx=idx, fold_axis=not single)
+            logits, _ = apply_fn(params, bn_state, x, train=True)
+            loss = cross_entropy(logits, labels)
+            if not single:
+                loss = lax.pmean(loss, DATA_AXIS)
+            return carry, loss
+
+        _, losses = lax.scan(one, jnp.int32(0), (imgs, labs, idxs))
+        return losses
+
+    if single:
+        @jax.jit
+        def fwd_window(state: TrainState, key, epoch_images, epoch_labels,
+                       start, length_arr):
+            return fwd_body(state.params, state.bn_state, key, epoch_images,
+                            epoch_labels, start, length_arr)
+
+        return fwd_window
+
+    mapped = shard_map(
+        fwd_body, mesh=mesh,
+        in_specs=(P(), P(), P(), P(None, DATA_AXIS), P(None, DATA_AXIS),
+                  P(), P()),
+        out_specs=P())
+
+    @jax.jit
+    def fwd_window(state: TrainState, key, epoch_images, epoch_labels,
+                   start, length_arr):
+        return mapped(state.params, state.bn_state, key, epoch_images,
+                      epoch_labels, start, length_arr)
+
+    return fwd_window
 
 
 def masked_eval_counts(logits: jax.Array, labels: jax.Array):

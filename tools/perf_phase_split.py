@@ -1,0 +1,72 @@
+"""Reproduce the BASELINE.md forward/backward split artifact.
+
+The reference times forward and backward+sync+step separately
+(``/root/reference/src/Part 1/main.py:33-43``).  On the tunneled TPU
+backend a per-step timer measures ~100 ms of dispatch latency, so the
+honest split is ``Trainer.measure_phase_split``'s two-window-size slope
+(see its docstring).  This tool runs the exact configuration of the
+committed table (VGG-11, f32, batch 256, W=100, 3 interleaved windows,
+two trials) and prints one JSON line per trial.
+
+Run:  python tools/perf_phase_split.py [--model vgg11] [--trials 2]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="vgg11")
+    p.add_argument("--global-batch", type=int, default=256)
+    p.add_argument("--window-iters", type=int, default=100)
+    p.add_argument("--windows", type=int, default=3)
+    # 3 trials: the tunnel's per-dispatch latency wobbles by tens of ms,
+    # and a single wobble among one trial's six window totals visibly
+    # skews a lone within-trial slope (observed); three trials of mins
+    # pin the across-trials slope to ~1% of the perf_pieces cross-check.
+    p.add_argument("--trials", type=int, default=3)
+    args = p.parse_args(argv)
+    if args.trials < 1:
+        p.error("--trials must be >= 1")
+
+    from cs744_ddp_tpu.train.loop import Trainer
+    from cs744_ddp_tpu.utils.compcache import \
+        enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    trainer = Trainer(model=args.model, strategy="single", num_devices=1,
+                      global_batch=args.global_batch,
+                      data_dir=os.environ.get("CIFAR_DATA_DIR", "./data"),
+                      log=lambda s: None)
+    best = {}
+    w = half = None
+    for _ in range(args.trials):
+        split = trainer.measure_phase_split(
+            window_iters=args.window_iters, windows=args.windows)
+        w, half = split["window_iters"], split["window_iters"] // 2
+        for k, v in split["window_totals_ms"].items():
+            best[k] = min(best.get(k, float("inf")), v)
+        print(json.dumps({k: round(v, 4) if isinstance(v, float) else v
+                          for k, v in split.items() if k != "window_totals_ms"},
+                         ), file=sys.stderr)
+    # Across-trials slope: mins over every trial's windows — one contended
+    # half-window min within a single trial cannot skew this estimate.
+    span = w - half
+    fwd = (best[f"fwd_{w}"] - best[f"fwd_{half}"]) / span
+    step = (best[f"step_{w}"] - best[f"step_{half}"]) / span
+    print(json.dumps({"model": args.model, "protocol":
+                      f"two-size slope W={w}/{half}, "
+                      f"best of {args.trials}x{args.windows} windows",
+                      "forward_ms_per_iter": round(fwd, 4),
+                      "backward_ms_per_iter": round(step - fwd, 4),
+                      "step_ms_per_iter": round(step, 4)}))
+
+
+if __name__ == "__main__":
+    main()
