@@ -1,0 +1,51 @@
+"""Unit tests for the HLO collective-stats parser behind bench.py's
+``spectrum`` section (utils/hlo_stats.py)."""
+
+from cs744_ddp_tpu.utils.hlo_stats import bytes_of_type, collective_stats
+
+# Shapes/ops modeled on real v5e HLO text (layout/tiling annotations and
+# tuple results included).
+SAMPLE = """\
+HloModule jit_step
+%psum_invariant.54 = f32[8]{0:T(128)S(1)} all-reduce(%x), channel_id=1
+%all-reduce.14 = (f32[512,10]{0,1:T(8,128)S(1)}, f32[8]{0:T(128)S(1)}) all-reduce(%a, %b), channel_id=2
+%all-gather.15 = f32[24,3,3,8]{3,2,1,0:T(4,128)} all-gather(%p), dimensions={0}
+%ags = (f32[1024]{0}, f32[8192]{0}) all-gather-start(%q), dimensions={0}
+%agd = f32[8192]{0} all-gather-done(%ags)
+%rss = (f32[1048576]{0}, f32[262144]{0}) reduce-scatter-start(%r)
+%rsd = f32[262144]{0} reduce-scatter-done(%rss)
+ROOT %tuple.90 = (f32[512,10]{0,1}, f32[3,3,3,8]{3,2,1,0}) tuple(%t, %u)
+%custom-call.3 = f32[64]{0} custom-call(%all-gather.15), custom_call_target="x"
+"""
+
+
+def test_bytes_of_type():
+    assert bytes_of_type("f32[512,10]{0,1:T(8,128)S(1)}") == 512 * 10 * 4
+    assert bytes_of_type("(f32[8]{0}, bf16[8]{0})") == 8 * 4 + 8 * 2
+    assert bytes_of_type("u32[]{:S(2)}") == 4          # scalar
+    assert bytes_of_type("token[]") == 0               # unknown dtype skipped
+
+
+def test_collective_stats_counts_and_bytes():
+    s = collective_stats(SAMPLE)
+    # all-reduce: two sync instances; bytes = 8*4 + (512*10*4 + 8*4).
+    ar = s["ops"]["all-reduce"]
+    assert ar["count"] == 2
+    assert abs(ar["result_mib"] - (8 * 4 + 512 * 10 * 4 + 8 * 4) / 2**20) \
+        < 0.01
+    # all-gather: one sync + one async PAIR counted once; async bytes come
+    # from the -done result only (the -start tuple holds source buffers).
+    ag = s["ops"]["all-gather"]
+    assert ag["count"] == 2
+    assert abs(ag["result_mib"]
+               - (24 * 3 * 3 * 8 * 4 + 8192 * 4) / 2**20) < 0.01
+    # Async reduce-scatter pair: counted once, bytes from -done ONLY
+    # (1.0 MiB output; counting the -start tuple's source buffers too
+    # would read 5.0 MiB, and dropping -done would read 0 — both sides of
+    # the convention are discriminated at this size).
+    rs = s["ops"]["reduce-scatter"]
+    assert rs["count"] == 1
+    assert rs["result_mib"] == 1.0
+    # tuple/custom-call lines (which merely REFERENCE collectives as
+    # operands) are not collectives.
+    assert s["total_count"] == 5
