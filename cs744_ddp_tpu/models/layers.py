@@ -201,15 +201,23 @@ def _bn_train_bwd(res, cts, *, fence: bool = True):
     ct_mean = cts[1].astype(jnp.float32)
     ct_var = cts[2].astype(jnp.float32)
     dx = dx + ct_mean / n + (2.0 / n) * ct_var * (xhat / inv)
-    # Fusion fence: without it, XLA:TPU's post-main-fusion pass SIGILLs
-    # compiling models with MORE than ~8 of these custom backward blocks
-    # inside shard_map (observed on v5e; vgg13/16/19 and resnet18 all
-    # crashed, vgg11 — exactly 8 BNs — compiled).  The barrier caps the
-    # fusion cluster at the BN boundary; the CPU backend strips it.  On
-    # models that compile without it, the lost fusion opportunities cost
-    # real bandwidth: vgg11 measured +6.9% whole-step throughput unfenced
-    # (BASELINE.md round 4), so models at or under the threshold opt out
-    # via ``batchnorm_apply(..., fence=False)``.
+    # Fusion fence history and policy.  Round 3: XLA:TPU's post-main-
+    # fusion pass SIGILLed compiling models with more than ~8 of these
+    # custom backward blocks inside shard_map (vgg13/16/19 and resnet18
+    # all crashed; vgg11 — exactly 8 BNs — compiled), so the barrier was
+    # mandatory armor.  Round 4: the crash no longer reproduces on the
+    # current toolchain (probed unfenced at batch 256: vgg13/19 and
+    # resnet18/34; vgg16 is locked by the AOT compile test, which builds
+    # every VGG unfenced), which turns the fence into a pure
+    # compiler-SCHEDULING choice
+    # — the barrier is numerically an identity, and the CPU backend
+    # strips it.  Measured per family on v5e (BASELINE.md round 4):
+    # unfenced wins for VGGs (+6.9/+14.1/+9.5% for vgg11/13/19, so
+    # models/vgg.py passes fence=False), fenced wins for ResNets
+    # (resnet18 +7% fenced — capping fusion clusters at the BN boundary
+    # schedules the deep residual graph better; models/resnet.py keeps
+    # the default).  The AOT tests compile both regimes, so a compiler
+    # regression on either path fails CI loudly.
     if not fence:
         return (dx.astype(in_dtype), sum_dy_xhat, sum_dy)
     return lax.optimization_barrier(
@@ -225,10 +233,10 @@ def batchnorm_apply(params: Params, state: State, x: jax.Array, *,
                     ) -> Tuple[jax.Array, State]:
     """Torch-parity BatchNorm over NHWC.
 
-    ``fence`` selects the fenced (default, required for models with more
-    than ~8 BN layers — see _bn_train_bwd) or unfenced backward (faster
-    where the compiler survives it; numerics identical — the barrier is
-    semantically an identity).
+    ``fence`` selects the fenced (default) or unfenced backward — a
+    compiler-scheduling choice with identical numerics (the barrier is
+    semantically an identity); measured winners per model family are
+    recorded in _bn_train_bwd.
 
     Training normalizes with the *biased* batch variance and updates running
     stats with the *unbiased* variance (torch.nn.BatchNorm2d semantics,

@@ -61,14 +61,16 @@ def apply(params: Dict[str, Any], state: Dict[str, Any], x: jax.Array, *,
           train: bool, name: str = "VGG11") -> Tuple[jax.Array, Dict[str, Any]]:
     """x: [N,32,32,3] NHWC -> logits [N,10], new state."""
     cfg = CFG[name]
-    # BN backward fusion fence: required above ~8 BN layers (the v5e
-    # compiler SIGILLs — layers._bn_train_bwd), but VGG-11 sits exactly at
-    # the threshold and measures +6.9% whole-step throughput unfenced
-    # (BASELINE.md round 4; the barrier is numerically an identity, so
-    # this is purely a compiler-scheduling choice).  Deeper configs keep
-    # the fence; the AOT compile tests cover both regimes.
-    n_bn = sum(1 for c in cfg if c != "M")
-    fence = n_bn > 8
+    # BN backward fusion fence OFF for the whole VGG family: the round-3
+    # v5e compiler SIGILL that originally forced it no longer reproduces
+    # on the current toolchain (probed: vgg13/19 + resnet18/34 all AOT-
+    # compile unfenced at batch 256), and the per-model A/B on the chip
+    # measures unfenced VGGs consistently faster — vgg11 +6.9%, vgg13
+    # +14.1%, vgg19 +9.5% whole-step (BASELINE.md round 4).  The barrier
+    # is numerically an identity, so this is purely a compiler-scheduling
+    # choice; ResNets keep the fence (it WINS there, resnet18 +7% fenced —
+    # models/resnet.py), and the AOT compile tests cover both regimes.
+    fence = False
     new_bn_state = []
     i = 0
     for layer_cfg in cfg:
