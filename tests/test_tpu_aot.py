@@ -103,8 +103,8 @@ def test_gather_strategy_keeps_two_phase_shape_on_tpu(v5e8_mesh):
 
 
 def test_large_zoo_models_compile_for_v5e8(v5e8_mesh):
-    """vgg16 (13 BNs), vgg19 (16 BNs), resnet18 (20 BNs) and resnet34
-    (36 BNs) must compile for the 8-chip TPU topology.  Regression lock
+    """vgg13 (10 BNs), vgg16 (13 BNs), vgg19 (16 BNs), resnet18 (20 BNs)
+    and resnet34 (36 BNs) must compile for the 8-chip TPU topology.  Regression lock
     for the round-3 post-main-fusion SIGILL (every model beyond vgg11
     crashed the v5e compiler until the BN backward's fusion fence) — and
     since round 4 the lock covers BOTH fence regimes: every VGG compiles
@@ -114,6 +114,8 @@ def test_large_zoo_models_compile_for_v5e8(v5e8_mesh):
     models/layers.py::_bn_train_bwd has the full history."""
     from cs744_ddp_tpu.models import resnet
 
+    txt = _compile_step(v5e8_mesh, vgg.VGG13(), "ddp", 64)
+    assert " all-reduce(" in txt
     txt = _compile_step(v5e8_mesh, vgg.VGG16(), "ddp", 64)
     assert " all-reduce(" in txt
     txt = _compile_step(v5e8_mesh, vgg.VGG19(), "ddp", 64)
