@@ -87,6 +87,20 @@ def test_phase_split_windowed_orders_fwd_below_bwd(tmp_path, mesh4):
         np.asarray(a), b), tr.state.params, state_before)
 
 
+def test_phase_split_rejects_host_augment(tmp_path, mesh4):
+    """measure_phase_split times the compiled windowed path; on a
+    host_augment trainer it would silently measure a pipeline that
+    trainer never trains with, so it must refuse (same contract as
+    steady_state_throughput)."""
+    import pytest
+
+    tr = Trainer(model=tiny_cnn(), strategy="allreduce", mesh=mesh4,
+                 global_batch=64, data_dir=str(tmp_path), augment=True,
+                 host_augment=True, log=lambda s: None)
+    with pytest.raises(ValueError, match="host_augment"):
+        tr.measure_phase_split(window_iters=4)
+
+
 def test_host_augment_trains_deterministically(tmp_path, mesh4):
     """--host-augment (VERDICT r2 weak #7): the C++ host pipeline feeds
     preprocessed f32 batches through the per-batch path; training works,

@@ -210,3 +210,36 @@ def test_batchnorm_vjp_mean_var_cotangents_exact():
     np.testing.assert_allclose(np.asarray(jax.grad(fused)(x)),
                                np.asarray(jax.grad(ref)(x)),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_bf16_onepass_bn_stats_match_centered():
+    """bf16 mode's ONE-PASS batch statistics (E[x^2]-mean^2, f32
+    accumulation — layers._bn_train_fwd_impl) must stay within bf16-input
+    rounding of the centered two-pass form for the magnitudes this
+    workload produces (post-conv/post-BN activations, |mean|/std = O(1)).
+    Guards the documented bf16 deviation (BASELINE.md) against drifting
+    into the catastrophic-cancellation regime unnoticed."""
+    rng = np.random.default_rng(0)
+    # Representative magnitudes incl. a shifted-mean channel (mean ~ 8x
+    # std) — still far from the |mean|/std >> 1 cancellation regime.
+    base = rng.normal(size=(64, 8, 8, 16)).astype(np.float32)
+    base[..., 3] = base[..., 3] * 0.5 + 4.0
+    x16 = jnp.asarray(base, jnp.bfloat16)
+
+    y16, _, m16, v16, _ = jax.jit(layers._bn_train_fwd_impl)(
+        x16, jnp.ones((16,)), jnp.zeros((16,)))
+
+    # Oracle: centered two-pass stats over the SAME bf16-rounded values.
+    xf = np.asarray(x16, np.float64)
+    mean = xf.mean((0, 1, 2))
+    var = ((xf - mean) ** 2).mean((0, 1, 2))
+    np.testing.assert_allclose(np.asarray(m16), mean, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v16), var, rtol=1e-3, atol=1e-4)
+    assert y16.dtype == jnp.bfloat16
+    # f32 path keeps the centered formulation (its own f64 oracle over the
+    # UNrounded input).
+    y32, _, m32, v32, _ = jax.jit(layers._bn_train_fwd_impl)(
+        jnp.asarray(base), jnp.ones((16,)), jnp.zeros((16,)))
+    b64 = base.astype(np.float64)
+    var32 = ((b64 - b64.mean((0, 1, 2))) ** 2).mean((0, 1, 2))
+    np.testing.assert_allclose(np.asarray(v32), var32, rtol=1e-5)
