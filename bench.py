@@ -179,7 +179,16 @@ def _collect_spectrum(log, model: str, global_batch: int):
             log(f"[bench] spectrum: AOT compile failed for {name} "
                 f"({e!r}); section omitted")
             return None
-        out["per_strategy"][name] = collective_stats(txt)
+        stats = collective_stats(txt)
+        if stats["total_count"] == 0:
+            # Every tier in this loop MUST lower to collectives on an 8-chip
+            # mesh; zero means the HLO-text parser no longer matches this
+            # XLA version's print format — omit the section rather than
+            # record misleading zeros.
+            log(f"[bench] spectrum: parsed 0 collectives for {name} on the "
+                "8-chip lowering — HLO text format mismatch; section omitted")
+            return None
+        out["per_strategy"][name] = stats
     return out
 
 

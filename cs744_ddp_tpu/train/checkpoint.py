@@ -33,6 +33,9 @@ from .step import TrainState
 # structure error (or a config-digest mismatch that doesn't say WHY).
 # History: 1 = SGDState carried a step counter; 2 = it doesn't.
 STATE_FORMAT_VERSION = 2
+# The structure every pre-stamp directory holds (the 1 -> 2 change predates
+# the stamp's introduction) — what a missing stamp migrates to.
+_UNSTAMPED_DIR_VERSION = 2
 
 
 class CheckpointManager:
@@ -63,6 +66,21 @@ class CheckpointManager:
                         f"an unidentifiable run — delete the directory to "
                         f"start fresh") from e
             saved_ver = existing.get("state_format_version")
+            if saved_ver is None:
+                # Dirs written before the stamp existed: the step-counter
+                # removal (version 1 -> 2) predates the stamp's introduction
+                # by three rounds, so every unstamped dir on disk is KNOWN to
+                # hold the version-2 structure — accept it as exactly that
+                # (NOT as the current version, or a future bump to 3 would
+                # silently re-accept pre-stamp v2 dirs) and stamp the file
+                # below so the migration happens once.
+                saved_ver = _UNSTAMPED_DIR_VERSION
+                existing["state_format_version"] = _UNSTAMPED_DIR_VERSION
+                if jax.process_index() == 0:
+                    tmp = f"{self._config_path}.{os.getpid()}.stamp.tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(existing, f)
+                    os.replace(tmp, self._config_path)
             if saved_ver != STATE_FORMAT_VERSION:
                 raise ValueError(
                     f"checkpoint dir {directory} holds state-format version "

@@ -511,7 +511,9 @@ class Trainer:
                     if not safe_put(("item", item)):
                         return
                 safe_put(("done", None))
-            except Exception as e:   # surfaced in the consumer
+            except BaseException as e:  # noqa: BLE001 — every exit path
+                # must enqueue a sentinel or the consumer would block on an
+                # empty queue forever; surfaced (and re-raised) there.
                 safe_put(("err", e))
 
         t = threading.Thread(target=produce, daemon=True,
@@ -519,7 +521,21 @@ class Trainer:
         t.start()
         try:
             while True:
-                kind, payload = q.get()
+                try:
+                    kind, payload = q.get(timeout=1.0)
+                except queue.Empty:
+                    if t.is_alive():
+                        continue
+                    # Producer exited; its final put may have raced our
+                    # timeout, so drain non-blockingly before declaring it
+                    # died without a sentinel (only then fail loudly
+                    # instead of hanging).
+                    try:
+                        kind, payload = q.get_nowait()
+                    except queue.Empty:
+                        raise RuntimeError(
+                            "host-augment prefetch thread exited without "
+                            "delivering a batch or a completion sentinel")
                 if kind == "done":
                     break
                 if kind == "err":
@@ -528,6 +544,9 @@ class Trainer:
         finally:
             stop.set()
             t.join(timeout=10)
+            if t.is_alive():
+                self.log("warning: host-augment prefetch thread did not "
+                         "exit within 10s")
 
     def _warm_per_step_tail_shapes(self) -> None:
         """AOT-compile the ragged-tail shapes of the per-step programs.

@@ -32,9 +32,13 @@ _DTYPE_BYTES = {
 }
 
 # `%name = <result-type> <collective-op>(...)`; -start before the bare op
-# name so the alternation matches the longest form.
+# name so the alternation matches the longest form.  The `%` sigil is
+# optional: some XLA versions / print options emit HLO text without it, and
+# requiring it would silently report zero collectives there (bench.py's
+# _collect_spectrum additionally refuses to record all-zero stats for
+# strategies that must contain collectives).
 _COLL_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%[\w.\-]+\s*=\s*(?P<type>.+?)\s+"
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?P<type>.+?)\s+"
     r"(?P<op>all-reduce-start|all-reduce-done|all-reduce"
     r"|all-gather-start|all-gather-done|all-gather"
     r"|reduce-scatter-start|reduce-scatter-done|reduce-scatter"

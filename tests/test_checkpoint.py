@@ -110,3 +110,41 @@ def test_checkpoint_dir_rejects_different_hyperparameters(tmp_path, mesh4):
     shrink(tr2)
     with pytest.raises(ValueError, match="different training config"):
         tr2.run(2, checkpoint_dir=ckpt)
+
+
+def test_unstamped_checkpoint_dir_accepted_as_current_version(tmp_path,
+                                                              mesh4):
+    """Dirs written before the state_format_version stamp existed hold the
+    version-2 structure (the 1->2 change predates the stamp), so a missing
+    stamp must be accepted as the current version — a one-time migration —
+    rather than refusing resume (ADVICE r4)."""
+    import json
+    import os
+    ckpt = str(tmp_path / "ckpt")
+    tr = make(tmp_path, mesh4)
+    tr.run(1, checkpoint_dir=ckpt)
+    state_after_1 = jax.tree.map(np.asarray, tr.state)
+
+    # Strip the stamp, simulating a pre-stamp dir.
+    cfg_path = os.path.join(ckpt, "trainer_config.json")
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    del cfg["state_format_version"]
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+
+    lines = []
+    tr2 = make(tmp_path, mesh4)
+    tr2.log = lines.append
+    tr2.run(2, checkpoint_dir=ckpt)  # must resume, not raise
+    # Resume actually happened (a silent fresh start would also train, so
+    # the log line is the discriminating evidence) and training continued.
+    assert any("Resumed from checkpoint: epoch 1" in l for l in lines), lines
+    d = max(
+        float(np.max(np.abs(a - np.asarray(b)))) if a.size else 0.0
+        for a, b in zip(jax.tree.leaves(state_after_1),
+                        jax.tree.leaves(jax.tree.map(np.asarray, tr2.state))))
+    assert d > 0.0  # trained past the restored epoch
+    # The one-time migration stamped the dir.
+    with open(cfg_path) as f:
+        assert json.load(f)["state_format_version"] == 2
