@@ -64,6 +64,12 @@ V5E_BF16_PEAK_FLOPS = 197e12
 
 MODELS = ("vgg11", "resnet18")
 STRATEGIES = ("gather", "allreduce", "ddp")
+# Deep-model rows measured in the matrix beyond the full strategy cross:
+# the deep end of both families, ddp only (at world=1 the strategy spread
+# is near-zero information — BASELINE.md "1-chip strategy matrix" — but
+# depth-scaling regressions like the per-family BN fence choice show up
+# exactly here; VERDICT r4 item 7).
+DEEP_ROWS = (("vgg19", "ddp"), ("resnet34", "ddp"))
 HEADLINE_RUNS = 3
 
 
@@ -129,7 +135,8 @@ def _collect_spectrum(log, model: str, global_batch: int):
     from cs744_ddp_tpu.parallel import get_strategy
     from cs744_ddp_tpu.parallel.mesh import DATA_AXIS
     from cs744_ddp_tpu.train import step as steplib
-    from cs744_ddp_tpu.utils.hlo_stats import collective_stats
+    from cs744_ddp_tpu.utils.hlo_stats import (collective_chain_depth,
+                                               collective_stats)
 
     try:
         from jax.experimental import topologies
@@ -172,7 +179,14 @@ def _collect_spectrum(log, model: str, global_batch: int):
             step = steplib.make_train_step(
                 apply_fn, get_strategy(name), mesh, sgdlib.SGDConfig(),
                 augment=True)
-            txt = step.lower(*args).compile().as_text()
+            low = step.lower(*args)
+            # Latency shape: collectives forced sequential by data deps in
+            # the pre-optimization HLO (barrier chains still visible there;
+            # see hlo_stats.collective_chain_depth) — gather 2/leaf chained,
+            # allreduce 1/leaf chained, ddp 1/bucket independent.
+            chain_depth = collective_chain_depth(
+                low.compiler_ir(dialect="hlo").as_hlo_text())
+            txt = low.compile().as_text()
         except Exception as e:
             # Never let the static section kill a bench whose expensive
             # measurements already completed — omit it with the reason.
@@ -188,16 +202,18 @@ def _collect_spectrum(log, model: str, global_batch: int):
             log(f"[bench] spectrum: parsed 0 collectives for {name} on the "
                 "8-chip lowering — HLO text format mismatch; section omitted")
             return None
+        stats["chain_depth"] = chain_depth
         out["per_strategy"][name] = stats
     return out
 
 
 def run_bench(*, matrix: bool = True, sweep: bool = True,
               peak: bool = True, convergence: bool = True,
+              convergence_epochs: int = 3,
               spectrum: bool = True,
               max_iters: int = 100,
               global_batch: int = 256,
-              models=MODELS, strategies=STRATEGIES,
+              models=MODELS, strategies=STRATEGIES, deep_rows=DEEP_ROWS,
               headline_model: str = "vgg11",
               peak_batch_candidates=(1536, 2048),
               log=None) -> dict:
@@ -241,28 +257,44 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         **_mfu_fields(headline, headline_flops),
     }
 
-    # Convergence oracle — the reference's own correctness signal (1-epoch
-    # test accuracy, /root/reference/src/Part 1/main.py:74-76), tracked per
-    # round so the artifact carries it, not just a test assertion.  On this
-    # egress-less bench host the dataset is the deterministic synthetic
-    # fallback (real_data=false, labels derived from image statistics —
-    # learnable, so the accuracy still moves well above the 10% chance
-    # floor); real-CIFAR accuracy remains unverifiable here (BASELINE.md).
+    # Convergence oracle — the reference's own correctness signal (test
+    # accuracy after training, /root/reference/src/Part 1/main.py:74-76),
+    # tracked per round so the artifact carries it, not just a test
+    # assertion — and as a TRAJECTORY (per-epoch accuracy over
+    # ``convergence_epochs``; a half-broken step can luck into one
+    # above-chance epoch, not a rising multi-epoch trend — VERDICT r4
+    # item 3).  On this egress-less bench host the dataset is the
+    # deterministic synthetic fallback (real_data=false, labels derived
+    # from image statistics — learnable, so accuracy moves well above the
+    # 10% chance floor); real-CIFAR accuracy remains unverifiable here
+    # (BASELINE.md).
     if convergence:
         log(f"[bench] convergence: {headline_model}/{headline_strategy}, "
-            "1 epoch @ reference config")
+            f"{convergence_epochs} epochs @ reference config")
         trainer = _make_trainer(headline_model, headline_strategy, ndev,
                                 global_batch=global_batch, data_dir=data_dir,
                                 log=lambda s: None)
-        timers = trainer.train_model(0)
-        avg_loss, correct, acc = trainer.test_model()
+        per_epoch = []
+        first_loss = None
+        for ep in range(convergence_epochs):
+            timers = trainer.train_model(ep)
+            if first_loss is None:
+                first_loss = timers.losses[0]
+            avg_loss, _, acc = trainer.test_model()
+            per_epoch.append({
+                "train_loss_last": round(timers.losses[-1], 4),
+                "test_avg_loss": round(avg_loss, 4),
+                "test_accuracy_pct": round(acc, 2),
+            })
         result["convergence"] = {
-            "protocol": "1 epoch, reference config (global batch "
-                        f"{global_batch}, SGD 0.1/0.9/1e-4, f32)",
-            "train_loss_first": round(timers.losses[0], 4),
-            "train_loss_last": round(timers.losses[-1], 4),
-            "test_avg_loss": round(avg_loss, 4),
-            "test_accuracy_pct": round(acc, 2),
+            "protocol": f"{convergence_epochs} epochs, reference config "
+                        f"(global batch {global_batch}, SGD 0.1/0.9/1e-4, "
+                        "f32)",
+            "train_loss_first": round(first_loss, 4),
+            "train_loss_last": per_epoch[-1]["train_loss_last"],
+            "test_avg_loss": per_epoch[-1]["test_avg_loss"],
+            "test_accuracy_pct": per_epoch[-1]["test_accuracy_pct"],
+            "per_epoch": per_epoch,
             "real_data": trainer.real_data,
         }
 
@@ -275,26 +307,27 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         result["matrix"] = {}
         # flops depend on (model, precision, batch) only — strategies share.
         model_flops = {headline_model: headline_flops}
-        for model in models:
-            for strategy in strategies:
-                entry_key = f"{model}/{strategy}"
-                if model == headline_model and strategy == headline_strategy:
-                    # Iteration-for-iteration identical to a headline run —
-                    # reuse one run instead of another measurement.
-                    ips = headline_runs[0]
-                else:
-                    log(f"[bench] matrix: {entry_key} on {ndev} device(s)")
-                    ips, fl = _throughput(
-                        model, strategy, ndev, global_batch=global_batch,
-                        max_iters=max_iters, data_dir=data_dir,
-                        log=lambda s: None,
-                        want_flops=model not in model_flops, repeats=2,
-                        flops_log=log)
-                    model_flops.setdefault(model, fl)
-                result["matrix"][entry_key] = {
-                    "images_per_sec_per_chip": round(ips, 2),
-                    **_mfu_fields(ips, model_flops.get(model)),
-                }
+        pairs = [(m, s) for m in models for s in strategies]
+        pairs += [tuple(r) for r in deep_rows if tuple(r) not in pairs]
+        for model, strategy in pairs:
+            entry_key = f"{model}/{strategy}"
+            if model == headline_model and strategy == headline_strategy:
+                # Iteration-for-iteration identical to a headline run —
+                # reuse one run instead of another measurement.
+                ips = headline_runs[0]
+            else:
+                log(f"[bench] matrix: {entry_key} on {ndev} device(s)")
+                ips, fl = _throughput(
+                    model, strategy, ndev, global_batch=global_batch,
+                    max_iters=max_iters, data_dir=data_dir,
+                    log=lambda s: None,
+                    want_flops=model not in model_flops, repeats=2,
+                    flops_log=log)
+                model_flops.setdefault(model, fl)
+            result["matrix"][entry_key] = {
+                "images_per_sec_per_chip": round(ips, 2),
+                **_mfu_fields(ips, model_flops.get(model)),
+            }
 
     # Peak throughput: the parity protocol pins global batch 256 / f32
     # (the reference's config), which underfills the MXU on one chip; this

@@ -63,6 +63,109 @@ def bytes_of_type(type_str: str) -> int:
     return total
 
 
+# Computation headers come in two prints: optimized modules use
+# `%name (params) -> type {`, pre-optimization modules bare `name {`.
+_COMP_HEAD_RE = re.compile(
+    r"^\s*(?:ENTRY\s+)?(?P<name>%?[\w.\-]+)\s*(?:\([^)]*\))?"
+    r"\s*(?:->\s*[^{]*)?\{\s*$")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(?P<name>%?[\w.\-]+)\s*=\s*(?P<rhs>.+)$")
+# First `word(` after the result type is the opcode (type tokens like
+# f32[64,10]{1,0} never put a word directly before '(').
+_OP_RE = re.compile(r"(?:^|\s)(?P<op>[a-z][\w\-]*)\(")
+# Identifier tokens — the optimized print prefixes names with '%', the
+# pre-optimization print doesn't; lookups strip the sigil.  Non-name tokens
+# (dtypes, attribute keys) simply miss the def map and are ignored.
+_REF_RE = re.compile(r"[%A-Za-z_][\w.\-]*")
+
+_COLL_BASES = ("all-reduce", "all-gather", "reduce-scatter",
+               "collective-permute", "all-to-all")
+
+
+def _collective_weight(op: str) -> int:
+    """1 for a collective instruction (async start/done pairs counted once,
+    on the start), else 0."""
+    if op.endswith("-done"):
+        return 0
+    return int(re.sub(r"-start$", "", op) in _COLL_BASES)
+
+
+def collective_chain_depth(hlo_text: str) -> int:
+    """Longest dependency chain of collectives in the module: the number of
+    collectives that must execute SEQUENTIALLY (each consuming a value the
+    previous produced), regardless of how many run in total.
+
+    This is the latency SHAPE of a gradient-sync tier, statically: the
+    gather tier chains two dependent collectives per parameter leaf behind
+    a barrier chain (2 x 34 = 68 deep for VGG-11), the per-param all-reduce
+    tier one per leaf (34), the bucketed ddp tier one per ~25 MB bucket
+    (2) — the reference's Part 2a / 2b / 3 ordering
+    (``/root/reference/src/Part 3/main.py:61`` vs ``Part 2b/main.py:116``),
+    pinned even where wall-clock cannot be measured (tests/test_tpu_aot.py).
+
+    Feed it the PRE-OPTIMIZATION module print
+    (``lowered.compiler_ir(dialect="hlo").as_hlo_text()``): there the
+    strategies' ``optimization_barrier`` chains are still data
+    dependencies, so the depth is the sequencing the program semantically
+    imposes on the scheduler.  The post-scheduling print is NOT meaningful
+    input — barriers are dropped after scheduling and sequencing lives in
+    instruction order (and collectives hide inside async-wrapper
+    computations), so depth there undercounts.
+
+    Computed per computation over the SSA def-use graph (defs precede uses
+    in printed HLO); references to other computations (fusion bodies, while
+    bodies, reducers) add that computation's own internal depth.
+    """
+    # Split the module into computations; names are stored sigil-stripped.
+    comps: Dict[str, Dict[str, tuple]] = {}
+    cur: Dict[str, tuple] = {}
+    cur_name = None
+    for line in hlo_text.splitlines():
+        head = _COMP_HEAD_RE.match(line)
+        if head and line.rstrip().endswith("{") and "=" not in line:
+            cur_name = head.group("name").lstrip("%")
+            cur = comps.setdefault(cur_name, {})
+            continue
+        if line.strip() == "}":
+            cur_name = None
+            continue
+        if cur_name is None:
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        op_m = _OP_RE.search(m.group("rhs"))
+        if not op_m:
+            continue
+        refs = [r.lstrip("%") for r in _REF_RE.findall(m.group("rhs"))]
+        cur[m.group("name").lstrip("%")] = (op_m.group("op"), refs)
+
+    comp_depth: Dict[str, int] = {}
+
+    def depth_of_comp(cname: str, stack=()) -> int:
+        if cname in comp_depth:
+            return comp_depth[cname]
+        if cname in stack:   # recursive reference (shouldn't happen in HLO)
+            return 0
+        instrs = comps.get(cname, {})
+        d: Dict[str, int] = {}
+        best = 0
+        for name, (op, refs) in instrs.items():
+            w = _collective_weight(op)
+            for r in refs:
+                if r in d:
+                    w = max(w, _collective_weight(op) + d[r])
+                elif r in comps and r != cname:
+                    w = max(w, _collective_weight(op)
+                            + depth_of_comp(r, stack + (cname,)))
+            d[name] = w
+            best = max(best, w)
+        comp_depth[cname] = best
+        return best
+
+    return max((depth_of_comp(c) for c in comps), default=0)
+
+
 def collective_stats(hlo_text: str) -> Dict:
     """{"ops": {op: {"count", "result_mib"}}, "total_count",
     "total_result_mib"} over every collective instruction in the module."""
