@@ -115,3 +115,83 @@ class TestCifar10:
         assert real
         assert train.images.shape == (500, 32, 32, 3)
         assert test.images.shape == (50, 32, 32, 3)
+
+
+class TestRealFormatFixture:
+    """The real-CIFAR loading path, byte-level (VERDICT r4 item 8).
+
+    No egress means real-CIFAR accuracy can't be demonstrated here
+    (BASELINE.md), but the loader's bytes -> NHWC -> normalize path is
+    verified end-to-end against a COMMITTED fixture in the genuine
+    cifar-10-batches-py format (tools/make_cifar_fixture.py: bytes keys,
+    protocol-2 pickles, planar R/G/B rows) with independently computed
+    expectations — the same decode torchvision performs on the real files
+    (``/root/reference/src/Part 1/main.py:94-103``)."""
+
+    @pytest.fixture(scope="class")
+    def assets_dir(self):
+        import os
+        d = os.path.join(os.path.dirname(__file__), "assets")
+        if not os.path.isdir(os.path.join(d, "cifar-10-batches-py")):
+            pytest.skip("fixture assets not present")
+        return d
+
+    def test_loader_selects_real_data_with_expected_shapes(self, assets_dir):
+        train, test, real = cifar10.load(assets_dir)
+        assert real is True
+        assert train.images.shape == (5 * 64, 32, 32, 3)
+        assert train.images.dtype == np.uint8
+        assert train.labels.shape == (5 * 64,)
+        assert test.images.shape == (64, 32, 32, 3)
+        assert set(np.unique(test.labels)) == set(range(10))
+
+    def test_bytes_to_nhwc_against_independent_decode(self, assets_dir):
+        """Every byte: images[n, r, c, ch] == raw[n, 1024*ch + 32*r + c]
+        (the CIFAR spec's planar layout), decoded here with plain pickle +
+        integer indexing, sharing no code with the loader."""
+        import os
+        import pickle
+        train, test, _ = cifar10.load(assets_dir)
+        for file_idx, name in ((1, "data_batch_2"), (None, "test_batch")):
+            with open(os.path.join(assets_dir, "cifar-10-batches-py",
+                                   name), "rb") as f:
+                raw = pickle.load(f, encoding="bytes")
+            split = test if file_idx is None else train
+            base = 0 if file_idx is None else file_idx * 64
+            want = raw[b"data"].reshape(64, 3, 32, 32)
+            for n in (0, 7, 63):
+                for r, c, ch in ((0, 0, 0), (31, 31, 2), (13, 5, 1)):
+                    assert split.images[base + n, r, c, ch] == \
+                        want[n, ch, r, c]
+            # And the full tensor, vectorized.
+            np.testing.assert_array_equal(
+                split.images[base:base + 64], want.transpose(0, 2, 3, 1))
+            np.testing.assert_array_equal(
+                split.labels[base:base + 64],
+                np.asarray(raw[b"labels"], np.int32))
+
+    def test_normalize_matches_reference_constants(self, assets_dir):
+        """Device normalize on fixture bytes == (x/255 - mean)/std with the
+        reference's literal constants (``Part 1/main.py:82-83``)."""
+        train, _, _ = cifar10.load(assets_dir)
+        x = train.images[:8]
+        got = np.asarray(augment.normalize(jnp.asarray(x)))
+        mean = np.array([125.3, 123.0, 113.9], np.float32) / 255.0
+        std = np.array([63.0, 62.1, 66.7], np.float32) / 255.0
+        want = (x.astype(np.float32) / 255.0 - mean) / std
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_trainer_end_to_end_on_real_format_data(self, assets_dir, mesh4):
+        """A Trainer pointed at the fixture takes the REAL-data path
+        (real_data=True) and completes a train+eval epoch on it."""
+        from cs744_ddp_tpu.train.loop import Trainer
+        from tinynet import tiny_cnn
+        tr = Trainer(model=tiny_cnn(), strategy="ddp", mesh=mesh4,
+                     global_batch=64, data_dir=assets_dir, augment=True,
+                     limit_train_batches=3, limit_eval_batches=1,
+                     log=lambda s: None)
+        assert tr.real_data is True
+        timers = tr.train_model(0)
+        assert np.isfinite(timers.losses).all()
+        avg_loss, correct, acc = tr.test_model()
+        assert np.isfinite(avg_loss) and 0 <= acc <= 100

@@ -1,0 +1,60 @@
+"""Generate the committed real-format CIFAR-10 test fixture.
+
+Writes ``tests/assets/cifar-10-batches-py/`` in the EXACT on-disk format of
+the real dataset the reference downloads via torchvision
+(``/root/reference/src/Part 1/main.py:94-103``): one pickled dict per batch
+file with ``b"data"`` — uint8 ``[N, 3072]``, each row the R plane then G
+then B, each plane row-major 32x32 — and ``b"labels"`` — a plain list of
+ints.  Keys are bytes and the pickle is protocol 2, matching what
+``pickle.load(..., encoding="bytes")`` sees on the genuine (Python-2-era)
+files.
+
+This host has no egress (BASELINE.md: real-CIFAR *accuracy* remains
+unverifiable), so the loader's bytes -> NHWC -> normalize path is instead
+pinned at the byte level against this fixture (tests/test_data.py;
+VERDICT r4 item 8).  64 images per file keeps the committed assets small
+while covering every class.
+
+Regenerate (deterministic, seed fixed): ``python tools/make_cifar_fixture.py``
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+N_PER_FILE = 64
+
+
+def make_batch(rng: np.random.Generator, batch_label: bytes):
+    """One batch dict in the genuine format (bytes keys, planar rows)."""
+    data = rng.integers(0, 256, size=(N_PER_FILE, 3072), dtype=np.uint8)
+    labels = [int(x) for x in rng.integers(0, 10, size=N_PER_FILE)]
+    # Cover all 10 classes regardless of the draw (the fixture doubles as
+    # an eval-path asset; empty classes would weaken it).
+    labels[:10] = list(range(10))
+    return {
+        b"batch_label": batch_label,
+        b"labels": labels,
+        b"data": data,
+        b"filenames": [b"fixture_%05d.png" % i for i in range(N_PER_FILE)],
+    }
+
+
+def main(out_root: str | None = None) -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = out_root or os.path.join(here, os.pardir, "tests", "assets")
+    batch_dir = os.path.join(out, "cifar-10-batches-py")
+    os.makedirs(batch_dir, exist_ok=True)
+    rng = np.random.default_rng(20260731)
+    for i in range(1, 6):
+        with open(os.path.join(batch_dir, f"data_batch_{i}"), "wb") as f:
+            pickle.dump(make_batch(rng, b"training batch %d of 5" % i), f,
+                        protocol=2)
+    with open(os.path.join(batch_dir, "test_batch"), "wb") as f:
+        pickle.dump(make_batch(rng, b"testing batch 1 of 1"), f, protocol=2)
+    return batch_dir
+
+
+if __name__ == "__main__":
+    print(main())
