@@ -66,21 +66,16 @@ class CheckpointManager:
                         f"an unidentifiable run — delete the directory to "
                         f"start fresh") from e
             saved_ver = existing.get("state_format_version")
-            if saved_ver is None:
+            needs_stamp = saved_ver is None
+            if needs_stamp:
                 # Dirs written before the stamp existed: the step-counter
                 # removal (version 1 -> 2) predates the stamp's introduction
                 # by three rounds, so every unstamped dir on disk is KNOWN to
                 # hold the version-2 structure — accept it as exactly that
                 # (NOT as the current version, or a future bump to 3 would
-                # silently re-accept pre-stamp v2 dirs) and stamp the file
-                # below so the migration happens once.
+                # silently re-accept pre-stamp v2 dirs).
                 saved_ver = _UNSTAMPED_DIR_VERSION
                 existing["state_format_version"] = _UNSTAMPED_DIR_VERSION
-                if jax.process_index() == 0:
-                    tmp = f"{self._config_path}.{os.getpid()}.stamp.tmp"
-                    with open(tmp, "w") as f:
-                        json.dump(existing, f)
-                    os.replace(tmp, self._config_path)
             if saved_ver != STATE_FORMAT_VERSION:
                 raise ValueError(
                     f"checkpoint dir {directory} holds state-format version "
@@ -92,6 +87,14 @@ class CheckpointManager:
                 raise ValueError(
                     f"checkpoint dir {directory} belongs to a different "
                     f"training config: saved={existing}, current={config}")
+            if needs_stamp and jax.process_index() == 0:
+                # Persist the one-time migration stamp only AFTER both
+                # validations pass: a rejected resume attempt must never
+                # modify another run's on-disk metadata.
+                tmp = f"{self._config_path}.{os.getpid()}.stamp.tmp"
+                with open(tmp, "w") as f:
+                    json.dump(existing, f)
+                os.replace(tmp, self._config_path)
         self._mngr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
