@@ -100,6 +100,41 @@ void fl_augment_f32(const uint8_t* images, int n, const int32_t* offsets,
   });
 }
 
+// Pad-4 random crop + optional horizontal flip, staying uint8 (zero
+// padding).  The transfer-compact variant of fl_augment_f32 for windowed
+// staging: the stochastic transform happens here on the host; the affine
+// normalize (a per-channel scale+bias the compiler fuses into the first
+// conv's input read) runs on device, so the wire carries 1 byte/px, not 4.
+void fl_augment_u8(const uint8_t* images, int n, const int32_t* offsets,
+                   const uint8_t* flips, uint8_t* out, int nthreads) {
+  parallel_for_images(n, nthreads, [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      const uint8_t* img = images + (size_t)i * kImg;
+      uint8_t* dst = out + (size_t)i * kImg;
+      const int oy = offsets[2 * i], ox = offsets[2 * i + 1];
+      const bool flip = flips[i] != 0;
+      for (int y = 0; y < kH; ++y) {
+        const int sy = y + oy - kPad;
+        if (sy < 0 || sy >= kH) {
+          std::memset(dst + (size_t)y * kW * kC, 0, kW * kC);
+          continue;
+        }
+        for (int x = 0; x < kW; ++x) {
+          const int xx = flip ? (kW - 1 - x) : x;
+          const int sx = xx + ox - kPad;
+          uint8_t* px = dst + ((size_t)y * kW + x) * kC;
+          if (sx < 0 || sx >= kW) {
+            px[0] = px[1] = px[2] = 0;
+          } else {
+            const uint8_t* sp = img + ((size_t)sy * kW + sx) * kC;
+            px[0] = sp[0]; px[1] = sp[1]; px[2] = sp[2];
+          }
+        }
+      }
+    }
+  });
+}
+
 // Normalize only (the test transform: ToTensor + Normalize, main.py:91-93).
 void fl_normalize_f32(const uint8_t* images, int n, const float* mean,
                       const float* std_, float* out, int nthreads) {
@@ -117,6 +152,6 @@ void fl_normalize_f32(const uint8_t* images, int n, const float* mean,
   });
 }
 
-int fl_version() { return 1; }
+int fl_version() { return 2; }  // 2: + fl_augment_u8
 
 }  // extern "C"
