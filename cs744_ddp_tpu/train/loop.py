@@ -592,7 +592,7 @@ class Trainer:
     def _iter_host_windows(self, epoch: int):
         """Windowed host-augment pipeline (VERDICT r4 item 5): the producer
         thread gathers + C++-augments up to ``WINDOW`` consecutive batches
-        into ONE stacked f32 staging buffer, device-puts it whole, and the
+        into ONE stacked uint8 staging buffer, device-puts it whole, and the
         consumer dispatches one scanned window over it — the per-dispatch
         tunnel latency and transfer fixed costs amortize over the window
         exactly as the device path's windows do, while the transform stays
