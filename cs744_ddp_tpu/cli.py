@@ -54,8 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host-augment", action="store_true",
                    help="run the train transform in the C++ host pipeline "
                         "(data/native.py, the reference's DataLoader-worker "
-                        "model) and feed preprocessed f32 batches per step; "
-                        "default keeps the transform fused on device")
+                        "model), staged as uint8 window buffers and "
+                        "dispatched as scanned windows (per-batch f32 under "
+                        "--profile-phases); default keeps the transform "
+                        "fused on device")
     p.add_argument("--precision", default="f32", choices=["f32", "bf16"],
                    help="compute precision: f32 = reference parity; bf16 = "
                         "mixed precision (f32 master weights/optimizer/BN "
