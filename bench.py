@@ -31,9 +31,9 @@ Prints ONE JSON line whose head matches the driver contract
     at lr 0.01 — the reference lr collapses big models on the synthetic
     stand-in; see BASELINE.md), labeled ``real_data`` false when the
     synthetic fallback is in use (this host has no egress), and
-  * ``spectrum`` — static per-strategy collective counts and comm bytes
-    from the TPU v5e-8 AOT lowering (the strategy tiers' cost shapes,
-    independent of wall-clock noise).
+  * ``spectrum`` — static per-strategy collective counts, comm bytes and
+    dependency-chain depths from the TPU v5e-8 AOT lowering (the strategy
+    tiers' cost AND latency shapes, independent of wall-clock noise).
 
 Protocol (BASELINE.md): the reference's own measurement design — windowed
 wall-clock fenced by fetching the loss values, the first window (compile +

@@ -285,3 +285,28 @@ def test_host_augment_windowed_matches_per_step_path(tmp_path, mesh4):
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), atol=1e-4),
         tr_win.state.params, tr_step.state.params)
+
+
+def test_host_augment_windowed_respects_limit_and_close(tmp_path, mesh4):
+    """The windowed producer must STOP at limit_train_batches (emitting a
+    ragged window of exactly that many batches) and an abandoned consumer
+    must not wedge a producer that is BLOCKED on a full queue."""
+    msgs = []
+    tr = Trainer(model=tiny_cnn(), strategy="allreduce", mesh=mesh4,
+                 global_batch=64, data_dir=str(tmp_path), augment=True,
+                 host_augment=True, limit_train_batches=2,
+                 log=msgs.append)
+    emitted = list(tr._iter_host_windows(0))
+    assert [k for k, _ in emitted] == ["win"]
+    assert emitted[0][1][0] == 2  # exactly limit batches in one buffer
+    assert tr._host_window_shapes() == {2}
+
+    # Early abandonment with the producer genuinely mid-stream: no limit,
+    # so the full 781-batch epoch keeps the producer blocked in safe_put
+    # on the depth-2 queue when close() fires — the stop-event path, not
+    # a join of an already-dead thread.
+    tr.limit_train_batches = None
+    gen = tr._iter_host_windows(0)
+    next(gen)
+    gen.close()   # must not hang
+    assert not any("did not exit" in m for m in msgs), msgs
