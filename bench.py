@@ -33,7 +33,10 @@ Prints ONE JSON line whose head matches the driver contract
     synthetic fallback is in use (this host has no egress), and
   * ``spectrum`` — static per-strategy collective counts, comm bytes and
     dependency-chain depths from the TPU v5e-8 AOT lowering (the strategy
-    tiers' cost AND latency shapes, independent of wall-clock noise).
+    tiers' cost AND latency shapes, independent of wall-clock noise), and
+  * ``host_pipeline`` — windowed ``--host-augment`` throughput (the
+    reference's DataLoader-worker model; host->device-link-bound on the
+    tunneled bench host, see BASELINE.md).
 
 Protocol (BASELINE.md): the reference's own measurement design — windowed
 wall-clock fenced by fetching the loss values, the first window (compile +
@@ -77,9 +80,12 @@ HEADLINE_RUNS = 3
 
 def _make_trainer(model: str, strategy: str, num_devices, *,
                   global_batch: int, data_dir: str, log,
-                  precision: str = "f32", sgd_cfg=None):
+                  precision: str = "f32", sgd_cfg=None, **extra):
+    """Central Trainer construction; ``extra`` passes through any further
+    Trainer kwargs (host_augment, limit_train_batches, ...)."""
     from cs744_ddp_tpu.train.loop import Trainer
-    extra = {} if sgd_cfg is None else {"sgd_cfg": sgd_cfg}
+    if sgd_cfg is not None:
+        extra["sgd_cfg"] = sgd_cfg
     return Trainer(model=model, strategy=strategy, num_devices=num_devices,
                    global_batch=global_batch, data_dir=data_dir,
                    precision=precision, log=log, **extra)
@@ -213,7 +219,7 @@ def _collect_spectrum(log, model: str, global_batch: int):
 def run_bench(*, matrix: bool = True, sweep: bool = True,
               peak: bool = True, convergence: bool = True,
               convergence_epochs: int = 3,
-              spectrum: bool = True,
+              spectrum: bool = True, host_pipeline: bool = True,
               max_iters: int = 100,
               global_batch: int = 256,
               models=MODELS, strategies=STRATEGIES, deep_rows=DEEP_ROWS,
@@ -390,6 +396,41 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
                 }
         result["peak"] = best
 
+    # Host-pipeline throughput: the --host-augment mode (the reference's
+    # DataLoader-worker model — C++ crop/flip on host, windowed uint8
+    # staging since round 5).  Regression-tracked here because its wins
+    # were previously hand-measured only (BASELINE.md: 1,235 serial ->
+    # 1,756 prefetched -> 13,805 windowed img/s on the tunneled v5e
+    # host); bounded by the host->device link, not the chip.
+    if host_pipeline:
+        log(f"[bench] host_pipeline: {headline_model}/{headline_strategy}/"
+            "--host-augment, windowed")
+        lim = min(max_iters, 98)
+        trh = _make_trainer(headline_model, headline_strategy, ndev,
+                            global_batch=global_batch, data_dir=data_dir,
+                            log=lambda s: None, host_augment=True,
+                            limit_train_batches=lim)
+        # Images actually trained per epoch: the limit may exceed the
+        # epoch's full-batch count (large global batches), in which case
+        # the ragged tail trains too — assuming lim batches would inflate
+        # the rate.
+        nfull, tail_per = trh._per_rank_batch_counts()
+        images = (min(lim, nfull) * global_batch
+                  + (tail_per * trh.world
+                     if lim > nfull and tail_per else 0))
+        import time as _time
+        trh.train_model(0)  # compile + warm
+        best_ips = 0.0
+        for _ in range(3):
+            t0 = _time.time()
+            trh.train_model(0)
+            best_ips = max(best_ips, images / (_time.time() - t0))
+        result["host_pipeline"] = {
+            "mode": "windowed uint8 staging (fl_augment_u8), "
+                    "normalize fused on device",
+            "images_per_sec_per_chip": round(best_ips / ndev, 2),
+        }
+
     if sweep:
         # WEAK scaling: per-chip batch held at ``global_batch`` while the
         # mesh grows (global = global_batch x n).  The north star is
@@ -487,6 +528,8 @@ def main(argv=None) -> None:
     p.add_argument("--no-spectrum", action="store_true",
                    help="skip the static per-strategy collective-stats "
                         "section (v5e-8 AOT lowering)")
+    p.add_argument("--no-host-pipeline", action="store_true",
+                   help="skip the windowed --host-augment throughput entry")
     p.add_argument("--max-iters", type=int, default=100,
                    help="minimum steady-state iterations per config")
     p.add_argument("--global-batch", type=int, default=256)
@@ -498,6 +541,8 @@ def main(argv=None) -> None:
                        convergence=not (args.no_convergence
                                         or args.no_matrix),
                        spectrum=not (args.no_spectrum or args.no_matrix),
+                       host_pipeline=not (args.no_host_pipeline
+                                          or args.no_matrix),
                        max_iters=args.max_iters,
                        global_batch=args.global_batch)
     print(json.dumps(result))

@@ -57,6 +57,10 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     assert result["peak"]["images_per_sec_per_chip"] > 0
     assert "bf16" in result["peak"]["config"]
 
+    # Host-pipeline entry: windowed --host-augment throughput, tracked so
+    # the round-5 7.9x win cannot silently regress (BASELINE.md).
+    assert result["host_pipeline"]["images_per_sec_per_chip"] > 0
+
     # Convergence oracle: per-epoch accuracy TRAJECTORY on the active
     # (synthetic here) dataset — the reference's own correctness signal,
     # tracked per round, with a calibrated CI floor (VERDICT r4 item 3):
