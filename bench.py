@@ -405,7 +405,15 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
     if host_pipeline:
         log(f"[bench] host_pipeline: {headline_model}/{headline_strategy}/"
             "--host-augment, windowed")
+        # Cap at 98 batches (~half an epoch at batch 256): the path is
+        # host->device-link-bound at ~15 ms/batch on the tunneled host
+        # (BASELINE.md), so a full --max-iters run would spend minutes
+        # measuring the wire for no extra information.
         lim = min(max_iters, 98)
+        if lim < max_iters:
+            log(f"[bench] host_pipeline: capped at {lim} batches "
+                f"(link-bound path; --max-iters {max_iters} applies to "
+                "the device-bound sections)")
         trh = _make_trainer(headline_model, headline_strategy, ndev,
                             global_batch=global_batch, data_dir=data_dir,
                             log=lambda s: None, host_augment=True,
@@ -425,9 +433,14 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
             t0 = _time.time()
             trh.train_model(0)
             best_ips = max(best_ips, images / (_time.time() - t0))
+        from cs744_ddp_tpu.data import native as _native
         result["host_pipeline"] = {
             "mode": "windowed uint8 staging (fl_augment_u8), "
                     "normalize fused on device",
+            # False = the C++ library failed to load and the NumPy
+            # fallback ran — a much slower number that must not be read
+            # as a regression of the native path.
+            "native_lib": _native.available(),
             "images_per_sec_per_chip": round(best_ips / ndev, 2),
         }
 

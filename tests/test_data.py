@@ -195,3 +195,31 @@ class TestRealFormatFixture:
         assert np.isfinite(timers.losses).all()
         avg_loss, correct, acc = tr.test_model()
         assert np.isfinite(avg_loss) and 0 <= acc <= 100
+
+
+def test_fixture_assets_match_generator():
+    """The committed fixture bytes must be exactly what
+    tools/make_cifar_fixture.py generates (deterministic seed): a drifted
+    regeneration or a hand-edited asset would silently decouple the
+    byte-level loader tests from the documented generator."""
+    import os
+    import sys
+    import tempfile
+    tools = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+    sys.path.insert(0, tools)
+    try:
+        import make_cifar_fixture
+    finally:
+        sys.path.remove(tools)
+    committed = os.path.join(os.path.dirname(__file__), "assets",
+                             "cifar-10-batches-py")
+    if not os.path.isdir(committed):
+        pytest.skip("fixture assets not present")
+    with tempfile.TemporaryDirectory() as tmp:
+        fresh = make_cifar_fixture.main(tmp)
+        for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+            with open(os.path.join(fresh, name), "rb") as f:
+                want = f.read()
+            with open(os.path.join(committed, name), "rb") as f:
+                got = f.read()
+            assert got == want, f"{name} diverges from the generator"

@@ -49,3 +49,74 @@ def test_collective_stats_counts_and_bytes():
     # tuple/custom-call lines (which merely REFERENCE collectives as
     # operands) are not collectives.
     assert s["total_count"] == 5
+
+
+# A handcrafted module with a KNOWN collective dependency structure, in the
+# pre-optimization print format (bare names, computation headers without
+# arrows) collective_chain_depth is documented to consume:
+#   chain: ar1 -> (through elementwise add) -> ar2 -> ag1   depth 3
+#   parallel: ar_par (independent)                          depth 1
+#   while body with one collective, called from main        contributes 1
+DEPTH_SAMPLE = """\
+HloModule jit_window
+
+region_add.1 {
+  lhs = f32[] parameter(0)
+  rhs = f32[] parameter(1)
+  ROOT add.r = f32[] add(lhs, rhs)
+}
+
+body.2 {
+  bp = f32[8]{0} parameter(0)
+  ar.body = f32[8]{0} all-reduce(bp), to_apply=region_add.1
+  ROOT bt = f32[8]{0} add(ar.body, ar.body)
+}
+
+ENTRY main.3 {
+  p0 = f32[8]{0} parameter(0)
+  ar1 = f32[8]{0} all-reduce(p0), to_apply=region_add.1
+  mid = f32[8]{0} add(ar1, p0)
+  ar2 = f32[8]{0} all-reduce(mid), to_apply=region_add.1
+  ag1 = f32[64]{0} all-gather(ar2), dimensions={0}
+  ar_par = f32[8]{0} all-reduce(p0), to_apply=region_add.1
+  w = f32[8]{0} while(p0), body=body.2, condition=region_add.1
+  wdep = f32[8]{0} add(w, ag1)
+  ROOT out = f32[64]{0} all-gather(wdep), dimensions={0}
+}
+"""
+
+
+def test_collective_chain_depth_on_handcrafted_module():
+    from cs744_ddp_tpu.utils.hlo_stats import collective_chain_depth
+    # Longest chain: ar1 -> ar2 -> ag1 (3) then -> wdep -> ROOT out (4);
+    # the while's body contributes its internal depth (1) to w, giving
+    # w(1) -> wdep -> out(2) on that arm — the ar chain dominates.
+    assert collective_chain_depth(DEPTH_SAMPLE) == 4
+
+
+def test_collective_chain_depth_async_pairs_count_once():
+    from cs744_ddp_tpu.utils.hlo_stats import collective_chain_depth
+    txt = """\
+ENTRY main {
+  p0 = f32[8]{0} parameter(0)
+  ags = (f32[8]{0}, f32[64]{0}) all-gather-start(p0), dimensions={0}
+  agd = f32[64]{0} all-gather-done(ags)
+  ar1 = f32[64]{0} all-reduce(agd)
+  ROOT r = f32[64]{0} add(ar1, ar1)
+}
+"""
+    # start counts 1, done 0 (one collective), then the dependent
+    # all-reduce: depth 2 — an async pair must not count twice.
+    assert collective_chain_depth(txt) == 2
+
+
+def test_collective_chain_depth_optimized_print_sigils():
+    from cs744_ddp_tpu.utils.hlo_stats import collective_chain_depth
+    txt = """\
+ENTRY %main.1 (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  %ar1 = f32[8]{0:T(128)} all-reduce(%p0), channel_id=1
+  ROOT %ar2 = f32[8]{0:T(128)} all-reduce(%ar1), channel_id=2
+}
+"""
+    assert collective_chain_depth(txt) == 2
