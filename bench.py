@@ -280,9 +280,13 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
     if convergence:
         log(f"[bench] convergence: {headline_model}/{headline_strategy}, "
             f"{convergence_epochs} epochs @ reference config")
+        # In-memory telemetry recorder (no out_dir): the section's steady-
+        # state step-time percentiles ride along in the bench artifact.
+        from cs744_ddp_tpu.obs import Telemetry
+        conv_tel = Telemetry()
         trainer = _make_trainer(headline_model, headline_strategy, ndev,
                                 global_batch=global_batch, data_dir=data_dir,
-                                log=lambda s: None)
+                                log=lambda s: None, telemetry=conv_tel)
         per_epoch = []
         first_loss = None
         for ep in range(convergence_epochs):
@@ -305,6 +309,8 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
             "test_accuracy_pct": per_epoch[-1]["test_accuracy_pct"],
             "per_epoch": per_epoch,
             "real_data": trainer.real_data,
+            "telemetry_summary": conv_tel.finalize(
+                global_batch=global_batch),
         }
         # Companion entry at a stable lr: the reference's lr=0.1 is tuned
         # for real CIFAR-10 and COLLAPSES the big models on the synthetic
@@ -414,10 +420,12 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
             log(f"[bench] host_pipeline: capped at {lim} batches "
                 f"(link-bound path; --max-iters {max_iters} applies to "
                 "the device-bound sections)")
+        from cs744_ddp_tpu.obs import Telemetry as _Telemetry
+        host_tel = _Telemetry()   # in-memory; summary attached below
         trh = _make_trainer(headline_model, headline_strategy, ndev,
                             global_batch=global_batch, data_dir=data_dir,
                             log=lambda s: None, host_augment=True,
-                            limit_train_batches=lim)
+                            limit_train_batches=lim, telemetry=host_tel)
         # Images actually trained per epoch: the limit may exceed the
         # epoch's full-batch count (large global batches), in which case
         # the ragged tail trains too — assuming lim batches would inflate
@@ -442,6 +450,10 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
             # as a regression of the native path.
             "native_lib": _native.available(),
             "images_per_sec_per_chip": round(best_ips / ndev, 2),
+            # Spans cover host_augment / prefetch_put wall clock; the
+            # percentiles cover the timed epochs' steady windows.
+            "telemetry_summary": host_tel.finalize(
+                global_batch=global_batch),
         }
 
     if sweep:
@@ -558,7 +570,15 @@ def main(argv=None) -> None:
                                           or args.no_matrix),
                        max_iters=args.max_iters,
                        global_batch=args.global_batch)
-    print(json.dumps(result))
+    payload = json.dumps(result)
+    # Self-validate before emitting: the driver parses this single line, so
+    # a non-serializable value (numpy scalar, NaN under a strict parser)
+    # must fail HERE with a clear error, not downstream in the consumer.
+    reparsed = json.loads(payload)
+    if reparsed.keys() != result.keys():
+        raise RuntimeError("bench JSON round-trip dropped keys: "
+                           f"{set(result) ^ set(reparsed)}")
+    print(payload)
 
 
 if __name__ == "__main__":

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 
+from .obs import NULL, Telemetry
 from .ops import sgd
 from .parallel import mesh as meshlib
 from .train.loop import GLOBAL_BATCH, Trainer
@@ -83,6 +84,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="save TrainState after each epoch and auto-resume "
                         "from the latest checkpoint (beyond-parity: the "
                         "reference has no checkpointing)")
+    p.add_argument("--telemetry-out", default=None,
+                   help="write structured run telemetry to this directory: "
+                        "manifest.json (run header), events.jsonl (per-step "
+                        "events, spans, gauges) and summary.json (steady-"
+                        "state percentiles); render with "
+                        "tools/telemetry_report.py. Off by default (zero "
+                        "overhead); the stdout print schedule is unchanged "
+                        "either way")
     return p
 
 
@@ -90,6 +99,8 @@ def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     meshlib.initialize_distributed(args.master, args.num_nodes, args.rank,
                                    port=args.port)
+    telemetry = (Telemetry(args.telemetry_out)
+                 if args.telemetry_out is not None else NULL)
     trainer = Trainer(
         model=args.model,
         strategy=args.strategy,
@@ -104,9 +115,15 @@ def main(argv=None) -> None:
         host_augment=args.host_augment,
         limit_train_batches=args.limit_train_batches,
         limit_eval_batches=args.limit_eval_batches,
+        telemetry=telemetry,
     )
-    trainer.run(args.epochs, checkpoint_dir=args.checkpoint_dir,
-                profile_dir=args.profile_dir)
+    try:
+        trainer.run(args.epochs, checkpoint_dir=args.checkpoint_dir,
+                    profile_dir=args.profile_dir)
+    finally:
+        # summary.json even on an interrupted run — partial runs are the
+        # ones whose artifact is most needed.
+        telemetry.finalize(global_batch=args.batch_size)
 
 
 if __name__ == "__main__":

@@ -13,11 +13,14 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import warnings
 from typing import Optional
 
 import numpy as np
 
 from .cifar10 import MEAN, STD
+
+_EXPECTED_VERSION = 2
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -26,6 +29,11 @@ _SO_PATH = os.path.join(_NATIVE_DIR, "build", "libfastloader.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
+# Why the native path is off, when it is ("" while unattempted/loaded).
+# Surfaced in the telemetry manifest (obs/) so a silently-degraded run —
+# NumPy fallback where the C++ pipeline was expected — is diagnosable from
+# the run artifact; also warned ONCE at load time rather than swallowed.
+_load_error: Optional[str] = None
 
 
 def _nthreads() -> int:
@@ -34,7 +42,7 @@ def _nthreads() -> int:
 
 def load_library(build: bool = True) -> Optional[ctypes.CDLL]:
     """Load (building if needed) libfastloader.so; None when unavailable."""
-    global _lib, _load_attempted
+    global _lib, _load_attempted, _load_error
     if _lib is not None or _load_attempted:
         return _lib
     _load_attempted = True
@@ -64,15 +72,32 @@ def load_library(build: bool = True) -> Optional[ctypes.CDLL]:
         lib.fl_normalize_f32.argtypes = [u8p, ctypes.c_int, f32p, f32p, f32p,
                                          ctypes.c_int]
         lib.fl_version.restype = ctypes.c_int
-        assert lib.fl_version() == 2
+        version = lib.fl_version()
+        if version != _EXPECTED_VERSION:
+            raise RuntimeError(
+                f"libfastloader ABI version {version} != expected "
+                f"{_EXPECTED_VERSION} (stale build?)")
         _lib = lib
-    except Exception:
+    except Exception as e:
         _lib = None
+        _load_error = f"{type(e).__name__}: {e}"
+        warnings.warn(
+            f"native host loader unavailable ({_load_error}); falling back "
+            f"to the NumPy data path — expect slower host-side "
+            f"gather/augment", RuntimeWarning, stacklevel=2)
     return _lib
 
 
 def available() -> bool:
+    """True when the native library loaded (attempting the load if needed);
+    when False, ``load_error()`` says why."""
     return load_library() is not None
+
+
+def load_error() -> Optional[str]:
+    """Why the native library is unavailable (None while it is loaded or
+    the load has not been attempted yet)."""
+    return _load_error
 
 
 def _ptr(a: np.ndarray, ct):

@@ -1,0 +1,332 @@
+"""The telemetry recorder: JSONL events, wall-clock spans, manifest, summary.
+
+Three primitives, one file format:
+
+* **events**  — per-step records (``kind: "step"``): loss, step/forward wall
+  time, the steady flag (first 20-iteration window and ragged-tail dispatches
+  excluded, mirroring ``WindowedTimers``), epoch and iteration number.
+* **spans**   — named wall-clock regions (``kind: "span"``): host augment,
+  prefetch put, eval, compile/warmup, checkpoint save.  Spans nest; each
+  record carries its depth and parent name.  The span stack is thread-local
+  because the host-augment producer runs on its own thread.
+* **gauges/counters** — point-in-time values (``kind: "gauge"``) and
+  monotonic tallies (``kind: "counter"``): prefetch queue depth, native-
+  loader status, device ``memory_stats()``, collective op counts/bytes.
+
+A run directory holds three files: ``manifest.json`` (the run header,
+written once at trainer construction), ``events.jsonl`` (one JSON object per
+line, append-only), and ``summary.json`` (steady-state percentiles, written
+by ``finalize()``).  Construct with ``out_dir=None`` for an in-memory
+recorder (bench sections) — same API, events kept in ``.records``.
+
+The DISABLED path is ``NULL``: a stateless singleton whose methods do
+nothing and whose ``span()`` returns a shared no-op context manager, so a
+run without ``--telemetry-out`` performs zero file writes and zero per-step
+allocations (guard hot call sites on ``telemetry.enabled`` so even the
+argument dicts are never built).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+from typing import Any, Dict, IO, List, Optional, Tuple
+
+_SCHEMA_VERSION = 1
+
+
+def percentile(values: List[float], q: float) -> float:
+    """Linear-interpolation percentile of an UNSORTED sample, q in [0, 100].
+
+    Matches numpy's default ("linear") method: sorted [1..10] gives
+    p50 = 5.5, p95 = 9.55, p99 = 9.91.  Pure-python on purpose — the
+    summary path must not pull jax/numpy into report-only tooling.
+    """
+    if not values:
+        raise ValueError("percentile of empty sample")
+    xs = sorted(values)
+    if len(xs) == 1:
+        return float(xs[0])
+    rank = (q / 100.0) * (len(xs) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = rank - lo
+    return float(xs[lo] * (1.0 - frac) + xs[hi] * frac)
+
+
+def git_sha(cwd: Optional[str] = None) -> Optional[str]:
+    """Current commit sha, or None outside a git checkout."""
+    try:
+        out = subprocess.run(["git", "rev-parse", "HEAD"], cwd=cwd,
+                             capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+class _NullSpan:
+    """Shared no-op context manager — one instance for the whole process."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTelemetry:
+    """The disabled recorder: every method is a no-op, ``enabled`` is False.
+
+    Stateless (``__slots__ = ()``): recording through it cannot grow any
+    per-step list, and it never touches the filesystem.  Hot call sites
+    should still guard on ``.enabled`` so argument construction is skipped
+    too.
+    """
+    __slots__ = ()
+    enabled = False
+
+    def step(self, **fields) -> None:
+        pass
+
+    def gauge(self, name: str, value, **attrs) -> None:
+        pass
+
+    def counter(self, name: str, inc=1, **attrs) -> None:
+        pass
+
+    def span(self, name: str, **attrs):
+        return _NULL_SPAN
+
+    def write_manifest(self, fields: Dict[str, Any]) -> None:
+        pass
+
+    def finalize(self, **extra) -> Optional[Dict[str, Any]]:
+        return None
+
+
+NULL = NullTelemetry()
+
+
+class _Span:
+    __slots__ = ("_tel", "name", "attrs", "t0")
+
+    def __init__(self, tel: "Telemetry", name: str, attrs: Dict[str, Any]):
+        self._tel = tel
+        self.name = name
+        self.attrs = attrs
+        self.t0 = 0.0
+
+    def __enter__(self):
+        self._tel._push(self.name)
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        dur = time.time() - self.t0
+        parent, depth = self._tel._pop()
+        rec = {"kind": "span", "name": self.name, "t": self.t0,
+               "dur_s": dur, "depth": depth}
+        if parent is not None:
+            rec["parent"] = parent
+        if exc_type is not None:
+            rec["error"] = exc_type.__name__
+        if self.attrs:
+            rec.update(self.attrs)
+        self._tel._emit(rec)
+        return False
+
+
+class Telemetry:
+    """The enabled recorder.  ``out_dir=None`` keeps events in memory."""
+
+    enabled = True
+
+    def __init__(self, out_dir: Optional[str] = None):
+        self.out_dir = out_dir
+        self.records: List[Dict[str, Any]] = []  # in-memory mirror when no dir
+        self.manifest: Optional[Dict[str, Any]] = None
+        self.summary: Optional[Dict[str, Any]] = None
+        self._fh: Optional[IO[str]] = None
+        self._lock = threading.Lock()  # producer thread emits spans too
+        self._tls = threading.local()
+        self._counters: Dict[str, float] = {}
+        if out_dir is not None:
+            os.makedirs(out_dir, exist_ok=True)
+            self._fh = open(os.path.join(out_dir, "events.jsonl"), "a",
+                            buffering=1)
+
+    # -- span stack (per thread) -------------------------------------------
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _push(self, name: str) -> None:
+        self._stack().append(name)
+
+    def _pop(self) -> Tuple[Optional[str], int]:
+        st = self._stack()
+        st.pop()
+        return (st[-1] if st else None), len(st)
+
+    # -- emission -----------------------------------------------------------
+
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(json.dumps(rec) + "\n")
+            else:
+                self.records.append(rec)
+
+    def step(self, *, epoch: int, iter: int, loss: float, step_time: float,
+             forward_time: Optional[float] = None, steady: bool = True,
+             **extra) -> None:
+        rec = {"kind": "step", "t": time.time(), "epoch": epoch, "iter": iter,
+               "loss": float(loss), "step_time_s": float(step_time),
+               "steady": bool(steady)}
+        if forward_time is not None:
+            rec["forward_time_s"] = float(forward_time)
+        if extra:
+            rec.update(extra)
+        self._emit(rec)
+
+    def gauge(self, name: str, value, **attrs) -> None:
+        rec = {"kind": "gauge", "name": name, "t": time.time(),
+               "value": value}
+        if attrs:
+            rec.update(attrs)
+        self._emit(rec)
+
+    def counter(self, name: str, inc=1, **attrs) -> None:
+        with self._lock:
+            total = self._counters.get(name, 0) + inc
+            self._counters[name] = total
+        rec = {"kind": "counter", "name": name, "t": time.time(),
+               "inc": inc, "total": total}
+        if attrs:
+            rec.update(attrs)
+        self._emit(rec)
+
+    def span(self, name: str, **attrs) -> _Span:
+        return _Span(self, name, attrs)
+
+    # -- run header / footer -------------------------------------------------
+
+    def write_manifest(self, fields: Dict[str, Any]) -> None:
+        man = {"schema_version": _SCHEMA_VERSION, "created_at": time.time()}
+        man.update(fields)
+        self.manifest = man
+        if self.out_dir is not None:
+            path = os.path.join(self.out_dir, "manifest.json")
+            with open(path, "w") as f:
+                json.dump(man, f, indent=2, default=str)
+                f.write("\n")
+
+    def finalize(self, **extra) -> Dict[str, Any]:
+        """Compute the steady-state summary; write ``summary.json`` if the
+        recorder is file-backed.  Safe to call once at the end of a run —
+        also closes the event log."""
+        events = self._drain_events()
+        summary = summarize_events(events, **extra)
+        self.summary = summary
+        if self.out_dir is not None:
+            with open(os.path.join(self.out_dir, "summary.json"), "w") as f:
+                json.dump(summary, f, indent=2, default=str)
+                f.write("\n")
+            with self._lock:
+                if self._fh is not None:
+                    self._fh.close()
+                    self._fh = None
+        return summary
+
+    def _drain_events(self) -> List[Dict[str, Any]]:
+        if self.out_dir is None:
+            return list(self.records)
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+        path = os.path.join(self.out_dir, "events.jsonl")
+        if not os.path.exists(path):
+            return []
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+
+def summarize_events(events: List[Dict[str, Any]],
+                     global_batch: Optional[int] = None,
+                     **extra) -> Dict[str, Any]:
+    """Steady-state summary of an event list: step-time percentiles,
+    throughput, span totals, final counter values."""
+    steps = [e for e in events if e.get("kind") == "step"]
+    steady = [e["step_time_s"] for e in steps if e.get("steady")]
+    spans: Dict[str, Dict[str, float]] = {}
+    for e in events:
+        if e.get("kind") == "span":
+            agg = spans.setdefault(e["name"], {"count": 0, "total_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += e.get("dur_s", 0.0)
+    counters: Dict[str, float] = {}
+    for e in events:
+        if e.get("kind") == "counter":
+            counters[e["name"]] = e["total"]
+
+    summary: Dict[str, Any] = {
+        "schema_version": _SCHEMA_VERSION,
+        "num_events": len(events),
+        "num_steps": len(steps),
+        "num_steady_steps": len(steady),
+        "spans": spans,
+        "counters": counters,
+    }
+    if steps:
+        summary["final_loss"] = steps[-1]["loss"]
+        summary["mean_loss"] = sum(s["loss"] for s in steps) / len(steps)
+    if steady:
+        summary["steady_step_time_s"] = {
+            "p50": percentile(steady, 50),
+            "p95": percentile(steady, 95),
+            "p99": percentile(steady, 99),
+            "mean": sum(steady) / len(steady),
+            "min": min(steady),
+            "max": max(steady),
+        }
+        if global_batch:
+            summary["steady_images_per_sec"] = (
+                global_batch * len(steady) / sum(steady))
+    if global_batch:
+        summary["global_batch"] = global_batch
+    if extra:
+        summary.update(extra)
+    return summary
+
+
+def read_run(out_dir: str) -> Tuple[Optional[Dict[str, Any]],
+                                    List[Dict[str, Any]],
+                                    Optional[Dict[str, Any]]]:
+    """Load a run directory -> (manifest, events, summary); missing files
+    come back as None / empty list so partial runs still render."""
+    def _load(name):
+        path = os.path.join(out_dir, name)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    manifest = _load("manifest.json")
+    summary = _load("summary.json")
+    events: List[Dict[str, Any]] = []
+    path = os.path.join(out_dir, "events.jsonl")
+    if os.path.exists(path):
+        with open(path) as f:
+            events = [json.loads(line) for line in f if line.strip()]
+    return manifest, events, summary

@@ -147,7 +147,13 @@ _CHUNK_ROWS = 4
 def _sums_kernel(xhat_ref, dp_ref, gamma2_ref, beta2_ref, out_ref, *, c,
                  chunk_rows):
     """Phase 1: accumulate [2,C] = (sum_dy, sum_dy_xhat) over the grid,
-    streaming the block through chunk_rows-row chunks."""
+    streaming the block through chunk_rows-row chunks.
+
+    ASSUMES sequential grid execution: ``out_ref`` carries the running
+    accumulator from step to step (init at program 0, += after), so the
+    ``pallas_call`` must pin ``dimension_semantics=("arbitrary",)`` — on
+    megacore TPUs (v4/v5p) a parallel grid dimension would be split across
+    cores and the read-modify-write would race."""
     bn = xhat_ref.shape[0]
     gamma2, beta2 = gamma2_ref[:], beta2_ref[:]
     act = xhat_ref.dtype
@@ -243,12 +249,19 @@ def _pallas_backward(xhat, dp, gamma, beta, inv, out_dtype):
     sums_spec = pl.BlockSpec((2, c), lambda i: (0, 0),
                              memory_space=pltpu.VMEM)
 
+    # The sums kernel ACCUMULATES into out_ref across grid steps (phase-1
+    # reduction), which is only sound if the grid executes sequentially on
+    # one core: "arbitrary" semantics pin that, keeping megacore chips
+    # (v4/v5p, which otherwise split a parallel grid across two cores with
+    # separate out_ref instances) from racing the read-modify-write.
     sums = pl.pallas_call(
         partial(_sums_kernel, c=c, chunk_rows=chunk_rows),
         grid=grid,
         in_specs=[xh_spec, dp_spec, ch_spec, ch_spec],
         out_specs=sums_spec,
         out_shape=jax.ShapeDtypeStruct((2, c), jnp.float32),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary",)),
     )(xh5, dp, gamma2, beta2)
 
     sums2 = jnp.concatenate([sums, sums], axis=1)   # [2, 2C]

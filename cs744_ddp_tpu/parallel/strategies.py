@@ -66,6 +66,16 @@ from .bucketing import BucketPlan, DEFAULT_BUCKET_BYTES, make_plan
 Strategy = Callable[[Any, str], Any]
 
 
+def _axis_size(axis_name: str) -> int:
+    """Static mesh-axis size (``lax.axis_size`` where it exists; jax 0.4.x
+    spells it ``jax.core.axis_frame``).  Static on purpose: a ``psum(1)``
+    spelling would add a collective and distort the strategy spectrum."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    size = jax.core.axis_frame(axis_name)
+    return getattr(size, "size", size)
+
+
 def _after(x, dep):
     """Order ``x``'s consumers after ``dep`` (sequential-collective chains).
 
@@ -87,7 +97,7 @@ def local(grads: Any, axis_name: str) -> Any:
 
 def per_param_psum(grads: Any, axis_name: str) -> Any:
     """One all-reduce per leaf, sequentially; sum / world (Part 2b parity)."""
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     leaves, treedef = jax.tree.flatten(grads)
     out: List[Any] = []
     prev = None
@@ -133,7 +143,7 @@ def bucketed_psum(grads: Any, axis_name: str, *,
     the wire transfer itself."""
     if plan is None:
         plan = make_plan(grads, bucket_bytes)
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     leaves = jax.tree.leaves(grads)
     out: List[Any] = [None] * len(leaves)
     prev = ()

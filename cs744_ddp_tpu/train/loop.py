@@ -34,6 +34,7 @@ import numpy as np
 
 from .. import models as model_zoo
 from ..data import cifar10, native, sharding
+from ..obs import NULL, git_sha
 from ..ops import sgd
 from ..parallel import get_strategy, mesh as meshlib
 from ..utils.metrics import WINDOW, WindowedTimers
@@ -101,7 +102,8 @@ class Trainer:
                  reshuffle_each_epoch: bool = False,
                  limit_train_batches: Optional[int] = None,
                  limit_eval_batches: Optional[int] = None,
-                 log: Callable[[str], None] = print):
+                 log: Callable[[str], None] = print,
+                 telemetry=NULL):
         self.mesh = mesh if mesh is not None else meshlib.make_mesh(num_devices)
         self.world = self.mesh.devices.size
         if global_batch % self.world:
@@ -109,6 +111,11 @@ class Trainer:
                              f"world size {self.world}")
         self.global_batch = global_batch
         self.log = log
+        # Structured telemetry recorder (obs/) — NULL (a stateless no-op)
+        # by default, so the disabled path writes no files and allocates
+        # nothing per step; the stdout print schedule above/below is the
+        # reference-parity surface either way and is never redirected.
+        self.telemetry = telemetry
         self.profile_phases = profile_phases
         # host_augment: the train transform runs in the C++ host pipeline
         # (data/native.py fl_augment_f32 — the reference's DataLoader-worker
@@ -219,6 +226,92 @@ class Trainer:
         self._warmed_tail_shapes = set()
         self._warmed_window_shapes = set()
         self.last_epoch_timers: Optional[WindowedTimers] = None
+        self._collective_stats_emitted = False
+
+        if telemetry.enabled:
+            d0 = self.mesh.devices.flat[0]
+            telemetry.write_manifest({
+                "model": self.model_name,
+                "strategy": self.strategy_name,
+                "world_size": self.world,
+                "global_batch": global_batch,
+                "precision": precision,
+                "augment": augment,
+                "host_augment": host_augment,
+                "profile_phases": profile_phases,
+                "seed": seed,
+                "reshuffle_each_epoch": reshuffle_each_epoch,
+                "real_data": self.real_data,
+                "lr": sgd_cfg.lr, "momentum": sgd_cfg.momentum,
+                "weight_decay": sgd_cfg.weight_decay,
+                "jax_version": jax.__version__,
+                "backend": jax.default_backend(),
+                "device_kind": getattr(d0, "device_kind", str(d0)),
+                "num_devices": self.world,
+                # The native host loader degrades SILENTLY to NumPy; the
+                # manifest records whether this run really had the C++
+                # pipeline, and if not, why (data/native.py load_error).
+                "native_loader": {"available": native.available(),
+                                  "error": native.load_error()},
+                "git_sha": git_sha(),
+            })
+
+    # -- telemetry helpers ---------------------------------------------------
+
+    def _emit_device_gauges(self, epoch: int) -> None:
+        """Per-device ``memory_stats()`` gauges (backends without the API —
+        CPU — contribute nothing)."""
+        for d in self.mesh.devices.flat:
+            ms = getattr(d, "memory_stats", None)
+            if ms is None:
+                continue
+            try:
+                stats = ms()
+            except Exception:
+                continue
+            if not stats:
+                continue
+            keep = {k: stats[k] for k in
+                    ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                     "largest_alloc_size") if k in stats} or dict(stats)
+            self.telemetry.gauge("device_memory", keep, device=int(d.id),
+                                 epoch=epoch)
+
+    def _emit_collective_telemetry(self) -> None:
+        """Counters/gauges for the compiled train step's collective pattern
+        (utils/hlo_stats over the pre-optimization HLO): op counts, result
+        bytes and chain depth — the static cost shape of the gradient-sync
+        tier, attached to the run artifact.  Best-effort: backends that
+        cannot produce the HLO print contribute an error gauge instead."""
+        if self._collective_stats_emitted:
+            return
+        self._collective_stats_emitted = True
+        from ..utils import hlo_stats
+        try:
+            x = jax.ShapeDtypeStruct(
+                (self.global_batch, 32, 32, 3),
+                jnp.float32 if self.host_augment else jnp.uint8,
+                sharding=self._batch_sharding)
+            y = jax.ShapeDtypeStruct((self.global_batch,), jnp.int32,
+                                     sharding=self._batch_sharding)
+            step_fn = self.train_step_host if self.host_augment \
+                else self.train_step
+            txt = step_fn.lower(
+                self.state, jax.random.PRNGKey(0), x, y) \
+                .compiler_ir(dialect="hlo").as_hlo_text()
+        except Exception as e:
+            self.telemetry.gauge("collective_stats_error", repr(e))
+            return
+        stats = hlo_stats.collective_stats(txt)
+        for op, entry in stats["ops"].items():
+            self.telemetry.counter(f"collective_{op}_count", entry["count"])
+            self.telemetry.counter(f"collective_{op}_result_mib",
+                                   entry["result_mib"])
+        self.telemetry.gauge(
+            "collective_totals", {
+                "total_count": stats["total_count"],
+                "total_result_mib": stats["total_result_mib"],
+                "chain_depth": hlo_stats.collective_chain_depth(txt)})
 
     # -- dataset splits (generation-tracked for staging-cache keys) ---------
 
@@ -249,13 +342,16 @@ class Trainer:
 
     def _make_fwd_only(self):
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:              # jax < 0.6: experimental namespace
+            from jax.experimental.shard_map import shard_map
         from ..data import augment as aug
         from ..ops.loss import cross_entropy
         from ..parallel.mesh import DATA_AXIS
         from jax import lax
 
-        from ..train.step import maybe_cast
+        from ..train.step import _SHARD_MAP_KW, maybe_cast
 
         def body(params, bn_state, images, labels):
             # host_augment feeds preprocessed f32; otherwise normalize here.
@@ -266,7 +362,7 @@ class Trainer:
 
         mapped = shard_map(body, mesh=self.mesh,
                            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
-                           out_specs=P())
+                           out_specs=P(), **_SHARD_MAP_KW)
         return jax.jit(mapped)
 
     # -- on-device staging --------------------------------------------------
@@ -333,9 +429,11 @@ class Trainer:
             cache_key = (w, tuple(epoch_images.shape))
             if cache_key in self._warmed_window_shapes:
                 continue
-            self.train_window.lower(
-                self.state, key, epoch_images, epoch_labels, jnp.int32(0),
-                jnp.zeros((w,), jnp.int8)).compile()
+            with self.telemetry.span("compile_warmup",
+                                     program="train_window", window=w):
+                self.train_window.lower(
+                    self.state, key, epoch_images, epoch_labels,
+                    jnp.int32(0), jnp.zeros((w,), jnp.int8)).compile()
             self._warmed_window_shapes.add(cache_key)
 
     def _warm_tail_step(self, tail) -> None:
@@ -346,8 +444,10 @@ class Trainer:
         cache_key = (tail[0].shape[0], str(tail[0].dtype))
         if cache_key in self._warmed_tail_shapes:
             return
-        self.train_step.lower(
-            self.state, jax.random.PRNGKey(self.seed), *tail).compile()
+        with self.telemetry.span("compile_warmup", program="train_step_tail",
+                                 batch=int(tail[0].shape[0])):
+            self.train_step.lower(
+                self.state, jax.random.PRNGKey(self.seed), *tail).compile()
         self._warmed_tail_shapes.add(cache_key)
 
     def _stage_eval(self):
@@ -382,7 +482,10 @@ class Trainer:
             return self._train_model_per_step(epoch)
         if self.host_augment:
             return self._train_model_host_windowed(epoch)
-        timers = WindowedTimers(self.log)
+        if self.telemetry.enabled:
+            self._emit_collective_telemetry()
+        timers = WindowedTimers(self.log, telemetry=self.telemetry,
+                                epoch=epoch)
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
         staged = self._stage_train_epoch(epoch)
         self._warm_train_windows(staged)
@@ -422,7 +525,10 @@ class Trainer:
         exactly like the reference's DataLoader workers, so it is
         double-buffered the way theirs is: batch k+1 prepares on a
         producer thread while step k runs, ``_iter_host_batches``)."""
-        timers = WindowedTimers(self.log)
+        if self.telemetry.enabled:
+            self._emit_collective_telemetry()
+        timers = WindowedTimers(self.log, telemetry=self.telemetry,
+                                epoch=epoch)
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
         step_fn = self.train_step_host if self.host_augment \
             else self.train_step
@@ -491,11 +597,16 @@ class Trainer:
 
     def _put_host_augmented(self, imgs: np.ndarray, labs: np.ndarray,
                             epoch: int, it: int):
-        """Host-transform one batch and place the resulting f32 batch."""
-        xh = self._host_transform(imgs, len(labs), epoch, it)
-        return (meshlib.put_global(xh, self._batch_sharding),
-                meshlib.put_global(np.asarray(labs, np.int32),
-                                   self._batch_sharding))
+        """Host-transform one batch and place the resulting f32 batch.
+
+        Runs on the prefetch producer thread; the telemetry span stack is
+        thread-local, so these spans nest correctly there."""
+        with self.telemetry.span("host_augment"):
+            xh = self._host_transform(imgs, len(labs), epoch, it)
+        with self.telemetry.span("prefetch_put"):
+            return (meshlib.put_global(xh, self._batch_sharding),
+                    meshlib.put_global(np.asarray(labs, np.int32),
+                                       self._batch_sharding))
 
     # Prefetched batches queued ahead of the consumer: 2 = one in flight on
     # the producer thread plus one ready — the reference's num_workers=2
@@ -537,6 +648,11 @@ class Trainer:
         t.start()
         try:
             while True:
+                if self.telemetry.enabled:
+                    # Depth BEFORE the blocking get: 0 here means the
+                    # consumer is about to stall on the producer — the
+                    # pipeline-health signal this gauge exists for.
+                    self.telemetry.gauge("prefetch_queue_depth", q.qsize())
                 try:
                     kind, payload = q.get(timeout=1.0)
                 except queue.Empty:
@@ -613,10 +729,12 @@ class Trainer:
                 if not buf_x:
                     return True
                 k = len(buf_x)
-                x = meshlib.put_global(np.stack(buf_x),
-                                       self._epoch_sharding)
-                y = meshlib.put_global(
-                    np.stack(buf_y).astype(np.int32), self._epoch_sharding)
+                with self.telemetry.span("prefetch_put", window=k):
+                    x = meshlib.put_global(np.stack(buf_x),
+                                           self._epoch_sharding)
+                    y = meshlib.put_global(
+                        np.stack(buf_y).astype(np.int32),
+                        self._epoch_sharding)
                 buf_x.clear()
                 buf_y.clear()
                 return emit(("win", (k, x, y)))
@@ -634,8 +752,9 @@ class Trainer:
                     emit(("tail", (it, *self._put_host_augmented(
                         imgs, labs, epoch, it))))
                     return
-                buf_x.append(self._host_transform_u8(
-                    imgs, len(labs), epoch, it))
+                with self.telemetry.span("host_augment"):
+                    buf_x.append(self._host_transform_u8(
+                        imgs, len(labs), epoch, it))
                 buf_y.append(labs)
                 if len(buf_x) == WINDOW and not flush():
                     return
@@ -677,7 +796,10 @@ class Trainer:
         print/timing schedule.  The default host-augment mode since round
         5 — the per-step path remains under ``profile_phases`` (where
         per-batch dispatch is the point)."""
-        timers = WindowedTimers(self.log)
+        if self.telemetry.enabled:
+            self._emit_collective_telemetry()
+        timers = WindowedTimers(self.log, telemetry=self.telemetry,
+                                epoch=epoch)
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
         self._warm_per_step_tail_shapes()
         # Warm the window compiles so none lands inside a timed window.
@@ -690,9 +812,12 @@ class Trainer:
                 y_sds = jax.ShapeDtypeStruct(
                     (w, self.global_batch), jnp.int32,
                     sharding=self._epoch_sharding)
-                self.train_window_host.lower(
-                    self.state, key, x_sds, y_sds, jnp.int32(0),
-                    jnp.zeros((w,), jnp.int8)).compile()
+                with self.telemetry.span("compile_warmup",
+                                         program="train_window_host",
+                                         window=w):
+                    self.train_window_host.lower(
+                        self.state, key, x_sds, y_sds, jnp.int32(0),
+                        jnp.zeros((w,), jnp.int8)).compile()
                 self._warmed_window_shapes.add(cache_key)
         for kind, payload in self._iter_host_windows(epoch):
             if kind == "win":
@@ -741,20 +866,27 @@ class Trainer:
         step_fn = self.train_step_host if self.host_augment \
             else self.train_step
         if (tb, dtype_name) not in self._warmed_tail_shapes:
-            step_fn.lower(self.state, key, x, y).compile()
+            with self.telemetry.span("compile_warmup",
+                                     program="per_step_tail", batch=tb):
+                step_fn.lower(self.state, key, x, y).compile()
             self._warmed_tail_shapes.add((tb, dtype_name))
         if self.profile_phases and \
                 ("fwd", tb, dtype_name) not in self._warmed_tail_shapes:
-            self._fwd_only.lower(
-                self.state.params, self.state.bn_state, x, y).compile()
+            with self.telemetry.span("compile_warmup",
+                                     program="fwd_only_tail", batch=tb):
+                self._fwd_only.lower(
+                    self.state.params, self.state.bn_state, x, y).compile()
             self._warmed_tail_shapes.add(("fwd", tb, dtype_name))
 
     def test_model(self) -> Tuple[float, int, float]:
         """Full-test-set evaluation in one dispatch; prints the reference's
         line (``Part 1/main.py:74-76``): per-batch-averaged CE, correct/total,
         %."""
-        images, labels = self._stage_eval()
-        loss_sum, corr = self.eval_window(self.state, images, labels)
+        with self.telemetry.span("eval"):
+            images, labels = self._stage_eval()
+            loss_sum, corr = self.eval_window(self.state, images, labels)
+            # Value fetches inside the span so it covers real device work.
+            loss_sum, corr = float(loss_sum), int(corr)
         n = len(self.test_split.labels)
         if self.limit_eval_batches is not None:
             n = min(n, self.limit_eval_batches * self.global_batch)
@@ -820,9 +952,14 @@ class Trainer:
                     self.train_model(epoch)
                 self.log(f"Training time after {epoch + 1} epoch is "
                          f"{time.time() - t0}")
+                if self.telemetry.enabled:
+                    self.telemetry.gauge("epoch_time_s", time.time() - t0,
+                                         epoch=epoch)
+                    self._emit_device_gauges(epoch)
                 self.test_model()
                 if mngr is not None:
-                    mngr.save(epoch, self.state)
+                    with self.telemetry.span("checkpoint_save", epoch=epoch):
+                        mngr.save(epoch, self.state)
         finally:
             if mngr is not None:
                 mngr.close()

@@ -25,13 +25,36 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:                      # jax < 0.6 ships it as experimental
+    from jax.experimental.shard_map import shard_map
 
 from ..data import augment as aug
 from ..ops import sgd
 from ..ops.loss import cross_entropy
 from .. import parallel
 from ..parallel.mesh import DATA_AXIS
+
+# jax 0.4.x's experimental shard_map predates the VMA type system: there are
+# no replication rules for optimization_barrier (the strategies' sequencing
+# primitive), so the rep checker must be off; semantics are unchanged — every
+# replicated output below is produced by an explicit psum/pmean.
+import inspect as _inspect
+
+_SHARD_MAP_KW = ({"check_rep": False}
+                 if "check_rep" in _inspect.signature(shard_map).parameters
+                 else {})
+
+
+def pvary(x: jax.Array) -> jax.Array:
+    """Mark a replicated value device-varying (``lax.pcast`` where it
+    exists).  On jax 0.4.x shard_map there is no VMA typing and the
+    cotangent of a replicated input is already shard-local (verified: no
+    auto-psum on the transpose), so the identity is semantically exact."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, DATA_AXIS, to="varying")
+    return x
 
 
 def maybe_cast(x: jax.Array, compute_dtype) -> jax.Array:
@@ -140,8 +163,7 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
         # double-counting by a factor of world.  pcast-to-varying keeps the
         # grads genuinely shard-local so the strategy below is the ONLY
         # gradient reduction — its collective pattern, exactly once.
-        params_var = jax.tree.map(
-            lambda a: lax.pcast(a, DATA_AXIS, to="varying"), params)
+        params_var = jax.tree.map(pvary, params)
         (loss, new_bn), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params_var)
         grads = strategy(grads, DATA_AXIS)
@@ -154,6 +176,7 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
         shard_body, mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=(P(), P(), P(), P()),
+        **_SHARD_MAP_KW,
     )
 
     @jax.jit
@@ -205,7 +228,7 @@ def make_train_window(apply_fn: Callable,
             # the strategy is the only gradient reduction (no autodiff
             # psum of invariant-param cotangents double-counting it).
             diff_params = params if not axis_ok else jax.tree.map(
-                lambda a: lax.pcast(a, DATA_AXIS, to="varying"), params)
+                pvary, params)
             (loss, new_bn), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(diff_params)
             grads = strategy_fn(grads)
@@ -252,6 +275,7 @@ def make_train_window(apply_fn: Callable,
         in_specs=(P(), P(), P(), P(), P(None, DATA_AXIS), P(None, DATA_AXIS),
                   P(), P()),
         out_specs=(P(), P(), P(), P()),
+        **_SHARD_MAP_KW,
     )
 
     @partial(jax.jit, donate_argnums=(0,))
@@ -311,7 +335,7 @@ def make_fwd_window(apply_fn: Callable, mesh: Mesh, *, single: bool = False,
         fwd_body, mesh=mesh,
         in_specs=(P(), P(), P(), P(None, DATA_AXIS), P(None, DATA_AXIS),
                   P(), P()),
-        out_specs=P())
+        out_specs=P(), **_SHARD_MAP_KW)
 
     @jax.jit
     def fwd_window(state: TrainState, key, epoch_images, epoch_labels,
@@ -353,8 +377,7 @@ def make_eval_window(apply_fn: Callable, mesh: Mesh, *,
             return (l + loss_sum, c + correct), None
         # Initial carry must already be marked device-varying (each shard
         # accumulates its own partial sums) for shard_map's VMA typing.
-        init = (lax.pcast(jnp.float32(0.0), DATA_AXIS, to="varying"),
-                lax.pcast(jnp.int32(0), DATA_AXIS, to="varying"))
+        init = (pvary(jnp.float32(0.0)), pvary(jnp.int32(0)))
         (loss_sum, correct), _ = lax.scan(one, init, (images, labels))
         return loss_sum, correct
 
@@ -365,7 +388,7 @@ def make_eval_window(apply_fn: Callable, mesh: Mesh, *,
     mapped = shard_map(shard_body, mesh=mesh,
                        in_specs=(P(), P(), P(None, DATA_AXIS),
                                  P(None, DATA_AXIS)),
-                       out_specs=(P(), P()))
+                       out_specs=(P(), P()), **_SHARD_MAP_KW)
 
     @jax.jit
     def evaluate(state: TrainState, images, labels):
@@ -397,7 +420,7 @@ def make_eval_step(apply_fn: Callable, mesh: Mesh, *,
 
     mapped = shard_map(shard_body, mesh=mesh,
                        in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
-                       out_specs=(P(), P()))
+                       out_specs=(P(), P()), **_SHARD_MAP_KW)
 
     @jax.jit
     def step(state: TrainState, images, labels):

@@ -78,6 +78,22 @@ _OP_RE = re.compile(r"(?:^|\s)(?P<op>[a-z][\w\-]*)\(")
 # (dtypes, attribute keys) simply miss the def map and are ignored.
 _REF_RE = re.compile(r"[%A-Za-z_][\w.\-]*")
 
+# Debug annotations on the instruction RHS that can contain identifier-like
+# tokens: `metadata={op_name="..." source_file="..."}` and bare string
+# literals.  Without stripping them, a metadata op_name that happens to
+# collide with an instruction (or computation) name fabricates a dependency
+# edge and inflates collective_chain_depth.  Strings are removed FIRST so a
+# brace inside a quoted path cannot truncate the metadata match; structural
+# refs (`to_apply=reducer`, `body=loop_body`) sit outside both and survive.
+_STRING_RE = re.compile(r'"(?:[^"\\]|\\.)*"')
+_METADATA_RE = re.compile(r"metadata=\{[^{}]*\}")
+
+
+def _strip_annotations(rhs: str) -> str:
+    """RHS with string literals and ``metadata={...}`` blocks removed —
+    what reference extraction may safely tokenize."""
+    return _METADATA_RE.sub("", _STRING_RE.sub("", rhs))
+
 _COLL_BASES = ("all-reduce", "all-gather", "reduce-scatter",
                "collective-permute", "all-to-all")
 
@@ -137,7 +153,8 @@ def collective_chain_depth(hlo_text: str) -> int:
         op_m = _OP_RE.search(m.group("rhs"))
         if not op_m:
             continue
-        refs = [r.lstrip("%") for r in _REF_RE.findall(m.group("rhs"))]
+        refs = [r.lstrip("%")
+                for r in _REF_RE.findall(_strip_annotations(m.group("rhs")))]
         cur[m.group("name").lstrip("%")] = (op_m.group("op"), refs)
 
     comp_depth: Dict[str, int] = {}

@@ -15,14 +15,25 @@ from __future__ import annotations
 import time
 from typing import Callable, List, Optional
 
+from ..obs import NULL
+
 WINDOW = 20  # reference: report every 20 iterations, skip the first window
 
 
 class WindowedTimers:
-    """Per-phase accumulators over 20-iteration windows, warmup excluded."""
+    """Per-phase accumulators over 20-iteration windows, warmup excluded.
 
-    def __init__(self, log: Callable[[str], None] = print):
+    ``telemetry`` mirrors every recorded iteration into the structured event
+    log ALONGSIDE the reference-parity prints — the stdout schedule is the
+    parity surface and is never altered by the recorder (guarded emit: the
+    default ``NULL`` recorder costs nothing per step).
+    """
+
+    def __init__(self, log: Callable[[str], None] = print, *,
+                 telemetry=NULL, epoch: int = 0):
         self.log = log
+        self.telemetry = telemetry
+        self.epoch = epoch
         self.iter_number = 1
         self.epoch_loss = 0.0
         self.forward_time = 0.0
@@ -53,6 +64,11 @@ class WindowedTimers:
         self.losses.append(loss)
         self.total_time += step_time
         warmup = self.iter_number <= WINDOW
+        if self.telemetry.enabled:
+            self.telemetry.step(
+                epoch=self.epoch, iter=self.iter_number, loss=float(loss),
+                step_time=step_time, forward_time=forward_time,
+                steady=not warmup and steady)
         if forward_time is not None:
             self.forward_time += forward_time
             self.backward_time += step_time - forward_time

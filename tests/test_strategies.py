@@ -12,11 +12,16 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+try:
+    from jax import shard_map
+except ImportError:                      # jax < 0.6: experimental namespace
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from cs744_ddp_tpu.parallel import bucketing, strategies
 from cs744_ddp_tpu.parallel.mesh import DATA_AXIS
+from cs744_ddp_tpu.train.step import _SHARD_MAP_KW
 
 
 def tree_of_grads(key, scale=1.0):
@@ -34,7 +39,8 @@ def run_strategy(mesh, strategy, grads_per_device):
     (replicated) result.  grads leaves have a leading device axis."""
     f = shard_map(lambda g: strategy(
         jax.tree.map(lambda a: a[0], g), DATA_AXIS),
-        mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=P())
+        mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=P(),
+        **_SHARD_MAP_KW)
     return jax.jit(f)(grads_per_device)
 
 
@@ -100,7 +106,8 @@ def test_strategy_collective_patterns_in_stablehlo(mesh8):
     def counts(strategy):
         f = shard_map(lambda g: strategy(
             jax.tree.map(lambda a: a[0], g), DATA_AXIS),
-            mesh=mesh8, in_specs=(P(DATA_AXIS),), out_specs=P())
+            mesh=mesh8, in_specs=(P(DATA_AXIS),), out_specs=P(),
+            **_SHARD_MAP_KW)
         hlo = jax.jit(f).lower(stacked).as_text()  # StableHLO MLIR
         return (len(re.findall(r"stablehlo\.all_reduce", hlo)),
                 len(re.findall(r"stablehlo\.optimization_barrier", hlo)))
@@ -118,7 +125,8 @@ def test_strategy_collective_patterns_in_stablehlo(mesh8):
     # gather_scatter: all-gather + all-reduce per leaf, chained.
     f = shard_map(lambda g: strategies.gather_scatter(
         jax.tree.map(lambda a: a[0], g), DATA_AXIS),
-        mesh=mesh8, in_specs=(P(DATA_AXIS),), out_specs=P())
+        mesh=mesh8, in_specs=(P(DATA_AXIS),), out_specs=P(),
+        **_SHARD_MAP_KW)
     hlo = jax.jit(f).lower(stacked).as_text()
     assert len(re.findall(r"stablehlo\.all_gather", hlo)) == 4
     assert len(re.findall(r"stablehlo\.all_reduce", hlo)) == 4
@@ -133,6 +141,10 @@ def test_compiled_step_reaches_ddp_grade_fusion(mesh8):
     reducer.  On TPU the barrier chains keep the tiers distinct instead
     (tests/test_tpu_aot.py); pre-optimization structure is pinned in
     test_strategy_collective_patterns_in_stablehlo."""
+    if tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5):
+        pytest.skip("this jax's CPU backend keeps optimization barriers, so "
+                    "the all-reduce combiner never sees a fusable chain; the "
+                    "fusion capability is pinned on newer toolchains only")
     from tinynet import tiny_cnn
 
     import jax.numpy as jnp
@@ -153,6 +165,7 @@ def test_compiled_step_reaches_ddp_grade_fusion(mesh8):
         assert 1 <= n <= 2, (name, n)  # 4 grad leaves -> <= 2 collectives
 
 
+@pytest.mark.slow  # ~70s: ResNet-18 compile + timed steps on the CPU mesh
 def test_ddp_wallclock_not_slower_than_allreduce(mesh8):
     """Part 3's capability claim, measured: the bucketed-fused tier must not
     lose to per-param all-reduce on a model with many parameter leaves

@@ -1,0 +1,115 @@
+"""Render a telemetry run directory (obs/) into a human summary.
+
+A ``--telemetry-out`` run leaves three artifacts: ``manifest.json`` (the run
+header), ``events.jsonl`` (per-step events, spans, gauges, counters) and
+``summary.json`` (steady-state percentiles).  This tool prints them as one
+readable report — run header, step-time table, span totals, counters and
+the last value of every gauge — recomputing the summary from the raw events
+when ``summary.json`` is missing (interrupted runs).
+
+Run:  python tools/telemetry_report.py <run-dir> [--json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cs744_ddp_tpu.obs import read_run, summarize_events  # noqa: E402
+
+
+def _fmt_ms(seconds: float) -> str:
+    return f"{seconds * 1e3:.2f} ms"
+
+
+def render(out_dir: str) -> str:
+    manifest, events, summary = read_run(out_dir)
+    if summary is None:
+        # Interrupted run: recompute from the raw events so a partial run
+        # still renders (the report may be the only diagnostic artifact).
+        gb = (manifest or {}).get("global_batch")
+        summary = summarize_events(events, global_batch=gb)
+    lines = [f"telemetry run: {out_dir}", ""]
+
+    if manifest:
+        lines.append("== run manifest ==")
+        order = ["model", "strategy", "world_size", "global_batch",
+                 "precision", "augment", "host_augment", "jax_version",
+                 "backend", "device_kind", "git_sha"]
+        for k in order:
+            if k in manifest:
+                lines.append(f"  {k:<22} {manifest[k]}")
+        native = manifest.get("native_loader")
+        if native is not None:
+            status = "available" if native.get("available") else \
+                f"UNAVAILABLE ({native.get('error')})"
+            lines.append(f"  {'native_loader':<22} {status}")
+        lines.append("")
+
+    lines.append("== steady-state steps ==")
+    lines.append(f"  steps recorded         {summary.get('num_steps', 0)} "
+                 f"({summary.get('num_steady_steps', 0)} steady)")
+    st = summary.get("steady_step_time_s")
+    if st:
+        for q in ("p50", "p95", "p99", "mean", "min", "max"):
+            lines.append(f"  step time {q:<12} {_fmt_ms(st[q])}")
+    ips = summary.get("steady_images_per_sec")
+    if ips:
+        lines.append(f"  images/sec             {ips:,.0f}")
+    if "final_loss" in summary:
+        lines.append(f"  final loss             {summary['final_loss']:.4f}")
+    lines.append("")
+
+    if summary.get("spans"):
+        lines.append("== spans (total wall clock) ==")
+        for name, agg in sorted(summary["spans"].items(),
+                                key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"  {name:<22} x{agg['count']:<5} "
+                         f"{_fmt_ms(agg['total_s'])}")
+        lines.append("")
+
+    if summary.get("counters"):
+        lines.append("== counters (final) ==")
+        for name, total in sorted(summary["counters"].items()):
+            lines.append(f"  {name:<34} {total}")
+        lines.append("")
+
+    gauges = {}
+    for e in events:
+        if e.get("kind") == "gauge":
+            gauges[e["name"]] = e["value"]   # last write wins
+    if gauges:
+        lines.append("== gauges (last value) ==")
+        for name, value in sorted(gauges.items()):
+            lines.append(f"  {name:<22} {value}")
+        lines.append("")
+
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="render a --telemetry-out run directory")
+    p.add_argument("run_dir", help="directory holding manifest.json / "
+                                   "events.jsonl / summary.json")
+    p.add_argument("--json", action="store_true",
+                   help="emit the (re)computed summary as JSON instead of "
+                        "the human table")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        p.error(f"not a directory: {args.run_dir}")
+    if args.json:
+        manifest, events, summary = read_run(args.run_dir)
+        if summary is None:
+            summary = summarize_events(
+                events, global_batch=(manifest or {}).get("global_batch"))
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render(args.run_dir))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
