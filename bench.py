@@ -1,7 +1,13 @@
 """Benchmark: steady-state CIFAR-10 training throughput (images/sec/chip).
 
-Prints ONE JSON line whose head matches the driver contract
-({"metric", "value", "unit", "vs_baseline"}) and which additionally carries
+Emission contract (VERDICT r5 item 1): the FINAL stdout line is a COMPACT
+JSON head ({"metric", "value", "unit", "vs_baseline", "headline_stats",
+MFU fields}) guaranteed to fit the driver's 2000-byte tail capture — the
+full result grew past that bound in rounds 4/5 and the driver recorded
+``parsed: null``.  The full payload is printed as an EARLIER stdout line
+and written to a sidecar file (``BENCH_FULL.json``, committed) named by
+the head's ``full_payload_file`` field; ``emit_result`` implements and
+tests pin both.  The full payload carries
 
   * ``headline_stats`` — all N=3 independent headline runs with best /
     median / min (noise robustness on a shared host whose contention is
@@ -34,9 +40,13 @@ Prints ONE JSON line whose head matches the driver contract
   * ``spectrum`` — static per-strategy collective counts, comm bytes and
     dependency-chain depths from the TPU v5e-8 AOT lowering (the strategy
     tiers' cost AND latency shapes, independent of wall-clock noise), and
-  * ``host_pipeline`` — windowed ``--host-augment`` throughput (the
-    reference's DataLoader-worker model; host->device-link-bound on the
-    tunneled bench host, see BASELINE.md).
+  * ``host_pipeline`` — chunked windowed ``--host-augment`` throughput
+    (the reference's DataLoader-worker model; host->device-link-bound on
+    the tunneled bench host, see BASELINE.md), alongside the measured
+    pure-``device_put`` LINK FLOOR on synthetic and real-entropy bytes
+    (``measure_link_floor``) so the path's target is a fraction of
+    measured hardware rather than a round number, plus a ``chunk_sweep``
+    over the staging chunk count K.
 
 Protocol (BASELINE.md): the reference's own measurement design — windowed
 wall-clock fenced by fetching the loss values, the first window (compile +
@@ -126,14 +136,134 @@ def _mfu_fields(ips_per_chip: float, flops_per_image) -> dict:
             "mfu_vs_bf16_peak": round(tflops * 1e12 / V5E_BF16_PEAK_FLOPS, 4)}
 
 
-def _collect_spectrum(log, model: str, global_batch: int):
+def _matrix_pairs(ndev: int, models, strategies, deep_rows):
+    """The (model, strategy) rows the matrix measures.
+
+    At world=1 every strategy's sync collapses to a no-op, so the full
+    strategy cross is near-duplicate rows for zero information
+    (BASELINE.md "1-chip strategy matrix": spread within noise) — prune to
+    ONE strategy per model ("ddp", the flagship, or the first offered) and
+    reinvest the minutes in the bf16 deep row run_bench adds.  Deep rows
+    append beyond the cross either way."""
+    if ndev > 1:
+        pairs = [(m, s) for m in models for s in strategies]
+    else:
+        keep = "ddp" if "ddp" in strategies else strategies[0]
+        pairs = [(m, keep) for m in models]
+    pairs += [tuple(r) for r in deep_rows if tuple(r) not in pairs]
+    return pairs
+
+
+def measure_link_floor(log, *, global_batch: int, ndev: int,
+                       trials: int = 5) -> dict:
+    """Pure host->device goodput floor for the chunked staging path: time
+    nothing but ``put_global`` of WINDOW-sized uint8 buffers (the exact
+    shape/sharding the producer ships) and convert to an images/sec/chip
+    CEILING for the host pipeline.  Two byte distributions, because the
+    tunneled TPU transport compresses:
+
+      * ``synthetic`` — the class-templated synthetic split this
+        egress-less bench host actually trains on (compressible; round 5
+        measured the achieved pipeline ABOVE the incompressible-bytes
+        wire rate for exactly this reason), and
+      * ``real_entropy`` — real CIFAR-10 images from the committed
+        tests/assets fixture, tiled to fill the window (``unique_mib``
+        records how little unique content backs the tiling — an upper
+        bound on how compressible-in-principle the buffer is).
+
+    The host_pipeline target derived from this is "achieved >= X% of the
+    matching measured floor" (BASELINE.md, VERDICT item 3 closure) —
+    regression-tracked against hardware, not a round number."""
+    import time as _time
+
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cs744_ddp_tpu.data import cifar10
+    from cs744_ddp_tpu.parallel import mesh as meshlib
+    from cs744_ddp_tpu.utils.metrics import WINDOW
+
+    mesh = meshlib.make_mesh(None)
+    sharding = NamedSharding(mesh, P(None, meshlib.DATA_AXIS))
+    shape = (WINDOW, global_batch, 32, 32, 3)
+    per_image = 32 * 32 * 3
+    buf_mib = WINDOW * global_batch * per_image / 2**20
+
+    def _fill_tiled(images: np.ndarray) -> np.ndarray:
+        flat = images.reshape(-1, 32, 32, 3)
+        reps = -(-WINDOW * global_batch // len(flat))
+        tiled = np.tile(flat, (reps, 1, 1, 1))[:WINDOW * global_batch]
+        return np.ascontiguousarray(tiled.reshape(shape))
+
+    def _measure(buf: np.ndarray) -> dict:
+        # Two alternating source buffers so no put can be served from a
+        # same-object cache; the second differs by a per-trial byte flip.
+        bufs = [buf, buf.copy()]
+        best = float("inf")
+        for t in range(trials + 1):   # +1 warmup (first put pays setup)
+            src = bufs[t % 2]
+            src[0, 0, 0, 0, 0] ^= 0xFF   # defeat content-level caching
+            t0 = _time.time()
+            x = meshlib.put_global(src, sharding)
+            x.block_until_ready()
+            # Value fetch of one element: under the tunneled backend
+            # block_until_ready can return before the transfer completes.
+            np.asarray(x[0, 0, 0, 0, 0])
+            dt = _time.time() - t0
+            del x
+            if t > 0:
+                best = min(best, dt)
+        images_per_s = WINDOW * global_batch / best
+        return {
+            "mib_per_s": round(buf_mib / best, 1),
+            "ms_per_batch": round(best / WINDOW * 1e3, 2),
+            "floor_images_per_sec_per_chip": round(images_per_s / ndev, 1),
+        }
+
+    log(f"[bench] link_floor: {WINDOW}x{global_batch} u8 window "
+        f"({buf_mib:.1f} MiB), best of {trials}")
+    synth = cifar10._synthetic_split(WINDOW * global_batch, seed=7)
+    out = {
+        # In-process CPU "transfers" are memcpys (or aliased no-ops) —
+        # only a tpu backend's floor is a statement about the wire.
+        "backend": jax.default_backend(),
+        "window_batches": WINDOW,
+        "buffer_mib": round(buf_mib, 2),
+        "trials": trials,
+        "synthetic": _measure(np.ascontiguousarray(
+            synth.images.reshape(shape))),
+    }
+    fixture_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tests", "assets")
+    if cifar10.has_real_data(fixture_dir):
+        real, _, _ = cifar10.load(fixture_dir)[:3]
+        entry = _measure(_fill_tiled(real.images))
+        entry["unique_mib"] = round(
+            real.images.size / 2**20, 2)
+        out["real_entropy"] = entry
+    else:   # fixture missing on this checkout: floor still has one leg
+        log("[bench] link_floor: tests/assets CIFAR fixture missing; "
+            "real-entropy leg omitted")
+        out["real_entropy"] = None
+    return out
+
+
+def _collect_spectrum(log, model: str, global_batch: int,
+                      strategies=STRATEGIES,
+                      deep_rows=(("resnet34", "allreduce"),
+                                 ("resnet34", "ddp"))):
     """Static per-strategy collective stats from the TPU v5e-8 AOT lowering
     (deviceless topology — compiles anywhere the TPU compiler is present).
 
     This is the strategy-cost spectrum as the COMPILER sees it: collective
     instruction counts and result-buffer bytes per tier, immune to host
-    noise.  None (with a logged reason) where the TPU AOT client is
-    unavailable."""
+    noise.  ``per_strategy`` covers the headline ``model`` across
+    ``strategies``; ``deep_rows`` adds (model, strategy) rows for a deep
+    model (many more parameter leaves -> the chained-collective tiers'
+    latency shape scales with depth, where the bucketed ddp tier's does
+    not — that contrast IS the row's information).  None (with a logged
+    reason) where the TPU AOT client is unavailable."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -158,35 +288,38 @@ def _collect_spectrum(log, model: str, global_batch: int):
     # the measurement host has; keep it divisible.
     global_batch = -(-global_batch // 8) * 8
     mesh = Mesh(np.array(topo.devices), (DATA_AXIS,))
-    init_fn, apply_fn = model_zoo.get_model(model)
-    state = steplib.init_train_state(init_fn, jax.random.PRNGKey(0))
     rep = NamedSharding(mesh, P())
     sh = NamedSharding(mesh, P(DATA_AXIS))
-    state_sds = jax.tree.map(
-        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep), state)
-    args = (state_sds,
-            jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep),
-            jax.ShapeDtypeStruct((global_batch, 32, 32, 3), jnp.uint8,
-                                 sharding=sh),
-            jax.ShapeDtypeStruct((global_batch,), jnp.int32, sharding=sh))
-    grad_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
-                     for a in jax.tree.leaves(state.params))
-    out = {
-        "topology": "v5e:2x4 (AOT, deviceless)",
-        "model": model, "global_batch": global_batch,
-        "grad_mib": round(grad_bytes / 2**20, 2),
-        "note": "result_mib sums collective RESULT buffers: all-gather's "
-                "is world x its input, so the gather tier's world-times "
-                "traffic amplification (vs the reference's root-link "
-                "gather, Part 2a/main.py:117-127) is explicit — see "
-                "BASELINE.md 'Gather-tier traffic accounting'",
-        "per_strategy": {},
-    }
-    for name in ("gather", "allreduce", "ddp"):
-        log(f"[bench] spectrum: AOT-compiling {model}/{name} for v5e-8")
+    model_cache = {}
+
+    def _model_args(name):
+        """(apply_fn, step args, grad bytes) for one model, cached — the
+        deep rows reuse the headline model's init where they share it."""
+        if name not in model_cache:
+            init_fn, apply_fn = model_zoo.get_model(name)
+            state = steplib.init_train_state(init_fn, jax.random.PRNGKey(0))
+            state_sds = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=rep), state)
+            args = (state_sds,
+                    jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep),
+                    jax.ShapeDtypeStruct((global_batch, 32, 32, 3),
+                                         jnp.uint8, sharding=sh),
+                    jax.ShapeDtypeStruct((global_batch,), jnp.int32,
+                                         sharding=sh))
+            grad_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                             for a in jax.tree.leaves(state.params))
+            model_cache[name] = (apply_fn, args, grad_bytes)
+        return model_cache[name]
+
+    def _strategy_stats(mname, sname):
+        """collective_stats + chain_depth for one (model, strategy), or
+        None with the reason logged."""
+        apply_fn, args, _ = _model_args(mname)
+        log(f"[bench] spectrum: AOT-compiling {mname}/{sname} for v5e-8")
         try:
             step = steplib.make_train_step(
-                apply_fn, get_strategy(name), mesh, sgdlib.SGDConfig(),
+                apply_fn, get_strategy(sname), mesh, sgdlib.SGDConfig(),
                 augment=True)
             low = step.lower(*args)
             # Latency shape: collectives forced sequential by data deps in
@@ -199,20 +332,48 @@ def _collect_spectrum(log, model: str, global_batch: int):
         except Exception as e:
             # Never let the static section kill a bench whose expensive
             # measurements already completed — omit it with the reason.
-            log(f"[bench] spectrum: AOT compile failed for {name} "
+            log(f"[bench] spectrum: AOT compile failed for {mname}/{sname} "
                 f"({e!r}); section omitted")
             return None
         stats = collective_stats(txt)
         if stats["total_count"] == 0:
-            # Every tier in this loop MUST lower to collectives on an 8-chip
+            # Every tier here MUST lower to collectives on an 8-chip
             # mesh; zero means the HLO-text parser no longer matches this
             # XLA version's print format — omit the section rather than
             # record misleading zeros.
-            log(f"[bench] spectrum: parsed 0 collectives for {name} on the "
-                "8-chip lowering — HLO text format mismatch; section omitted")
+            log(f"[bench] spectrum: parsed 0 collectives for "
+                f"{mname}/{sname} on the 8-chip lowering — HLO text "
+                "format mismatch; section omitted")
             return None
         stats["chain_depth"] = chain_depth
+        return stats
+
+    _, _, grad_bytes = _model_args(model)
+    out = {
+        "topology": "v5e:2x4 (AOT, deviceless)",
+        "model": model, "global_batch": global_batch,
+        "grad_mib": round(grad_bytes / 2**20, 2),
+        "note": "result_mib sums collective RESULT buffers: all-gather's "
+                "is world x its input, so the gather tier's world-times "
+                "traffic amplification (vs the reference's root-link "
+                "gather, Part 2a/main.py:117-127) is explicit — see "
+                "BASELINE.md 'Gather-tier traffic accounting'",
+        "per_strategy": {},
+    }
+    for name in strategies:
+        stats = _strategy_stats(model, name)
+        if stats is None:
+            return None
         out["per_strategy"][name] = stats
+    if deep_rows:
+        out["deep_rows"] = {}
+        for mname, sname in deep_rows:
+            stats = _strategy_stats(mname, sname)
+            if stats is None:
+                return None
+            _, _, gb = _model_args(mname)
+            stats["grad_mib"] = round(gb / 2**20, 2)
+            out["deep_rows"][f"{mname}/{sname}"] = stats
     return out
 
 
@@ -223,6 +384,8 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
               max_iters: int = 100,
               global_batch: int = 256,
               models=MODELS, strategies=STRATEGIES, deep_rows=DEEP_ROWS,
+              spectrum_deep_rows=(("resnet34", "allreduce"),
+                                  ("resnet34", "ddp")),
               headline_model: str = "vgg11",
               peak_batch_candidates=(1536, 2048),
               log=None) -> dict:
@@ -338,17 +501,21 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         }
 
     if spectrum:
-        spec = _collect_spectrum(log, headline_model, global_batch)
+        # Always the full 3-tier cross (STRATEGIES default): the section's
+        # information IS the tier contrast, so it does not follow a pruned
+        # matrix ``strategies``.
+        spec = _collect_spectrum(log, headline_model, global_batch,
+                                 deep_rows=spectrum_deep_rows)
         if spec is not None:
             result["spectrum"] = spec
 
     if matrix:
         result["matrix"] = {}
-        # flops depend on (model, precision, batch) only — strategies share.
+        # flops depend on (model, batch) only — strategies and precision
+        # share (a bf16 matmul performs the same multiply-adds).
         model_flops = {headline_model: headline_flops}
-        pairs = [(m, s) for m in models for s in strategies]
-        pairs += [tuple(r) for r in deep_rows if tuple(r) not in pairs]
-        for model, strategy in pairs:
+        for model, strategy in _matrix_pairs(ndev, models, strategies,
+                                             deep_rows):
             entry_key = f"{model}/{strategy}"
             if model == headline_model and strategy == headline_strategy:
                 # Iteration-for-iteration identical to a headline run —
@@ -366,6 +533,25 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
             result["matrix"][entry_key] = {
                 "images_per_sec_per_chip": round(ips, 2),
                 **_mfu_fields(ips, model_flops.get(model)),
+            }
+        # One deep row in bf16 mixed precision at the parity batch: the
+        # parity matrix is f32-only and the peak entry changes batch AND
+        # precision at once, so neither isolates what mixed precision buys
+        # a DEEP model at the reference's config (VERDICT r5 satellite).
+        if deep_rows:
+            bmodel, bstrat = deep_rows[-1]
+            entry_key = f"{bmodel}/{bstrat}/bf16"
+            log(f"[bench] matrix: {entry_key} on {ndev} device(s)")
+            ips, fl = _throughput(
+                bmodel, bstrat, ndev, global_batch=global_batch,
+                max_iters=max_iters, data_dir=data_dir, log=lambda s: None,
+                precision="bf16", want_flops=bmodel not in model_flops,
+                repeats=2, flops_log=log)
+            model_flops.setdefault(bmodel, fl)
+            result["matrix"][entry_key] = {
+                "images_per_sec_per_chip": round(ips, 2),
+                "precision": "bf16",
+                **_mfu_fields(ips, model_flops.get(bmodel)),
             }
 
     # Peak throughput: the parity protocol pins global batch 256 / f32
@@ -410,7 +596,7 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
     # host); bounded by the host->device link, not the chip.
     if host_pipeline:
         log(f"[bench] host_pipeline: {headline_model}/{headline_strategy}/"
-            "--host-augment, windowed")
+            "--host-augment, chunked windowed")
         # Cap at 98 batches (~half an epoch at batch 256): the path is
         # host->device-link-bound at ~15 ms/batch on the tunneled host
         # (BASELINE.md), so a full --max-iters run would spend minutes
@@ -441,17 +627,46 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
             t0 = _time.time()
             trh.train_model(0)
             best_ips = max(best_ips, images / (_time.time() - t0))
+        # Chunk-count sweep: K=1 is round 5's whole-window staging (the
+        # degenerate control — no overlap), larger K trades per-put
+        # fixed cost for compute/transfer overlap.  1 warm epoch +
+        # best-of-2 per point (vs best-of-3 for the main K above).
+        chunk_sweep = {str(trh.host_chunks): round(best_ips / ndev, 2)}
+        for k in (1, 2, 8):
+            if k == trh.host_chunks:
+                continue
+            log(f"[bench] host_pipeline: chunk_sweep K={k}")
+            trk = _make_trainer(headline_model, headline_strategy, ndev,
+                                global_batch=global_batch,
+                                data_dir=data_dir, log=lambda s: None,
+                                host_augment=True, host_chunks=k,
+                                limit_train_batches=lim)
+            trk.train_model(0)
+            k_ips = 0.0
+            for _ in range(2):
+                t0 = _time.time()
+                trk.train_model(0)
+                k_ips = max(k_ips, images / (_time.time() - t0))
+            chunk_sweep[str(k)] = round(k_ips / ndev, 2)
         from cs744_ddp_tpu.data import native as _native
         result["host_pipeline"] = {
-            "mode": "windowed uint8 staging (fl_augment_u8), "
-                    "normalize fused on device",
+            "mode": "chunked uint8 staging (fl_gather_augment_u8 into a "
+                    "reusable arena, per-chunk device_put overlapped with "
+                    "the previous window's compute, on-device "
+                    "concatenate), normalize fused on device",
             # False = the C++ library failed to load and the NumPy
             # fallback ran — a much slower number that must not be read
             # as a regression of the native path.
             "native_lib": _native.available(),
+            "host_chunks": trh.host_chunks,
             "images_per_sec_per_chip": round(best_ips / ndev, 2),
-            # Spans cover host_augment / prefetch_put wall clock; the
-            # percentiles cover the timed epochs' steady windows.
+            # The pure-device_put ceiling this achieved number is judged
+            # against (BASELINE.md VERDICT item 3 closure).
+            "link_floor": measure_link_floor(
+                log, global_batch=global_batch, ndev=ndev),
+            "chunk_sweep": chunk_sweep,
+            # Spans cover host_augment / chunk_put / chunk_wait wall
+            # clock; percentiles cover the timed epochs' steady windows.
             "telemetry_summary": host_tel.finalize(
                 global_batch=global_batch),
         }
@@ -528,6 +743,44 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
     return result
 
 
+# The compact head's keys (module docstring "Emission contract"): the
+# driver tail-captures ~2000 bytes of stdout and JSON-parses the LAST
+# line, so the head carries only the fixed-size summary fields plus a
+# pointer to the sidecar with everything else.
+CONTRACT_KEYS = ("metric", "value", "unit", "vs_baseline", "num_devices",
+                 "headline_stats", "tflops_per_sec", "mfu_vs_bf16_peak")
+HEAD_LINE_BUDGET = 1800   # bytes, < the driver's ~2000-byte tail capture
+
+
+def emit_result(result: dict, sidecar_path: str, out=print) -> dict:
+    """Emit a bench result per the driver contract: full payload FIRST (one
+    stdout line + the ``sidecar_path`` file), compact head as the FINAL
+    stdout line.  Rounds 4/5 printed the full payload as the last line and
+    overflowed the driver's tail capture ("parsed": null in BENCH_r04/r05)
+    — hence the split, and the hard size check on the head.  Returns the
+    head dict; tests/test_bench.py pins both emissions."""
+    payload = json.dumps(result)
+    # Self-validate before emitting: a non-serializable value (numpy
+    # scalar, NaN under a strict parser) must fail HERE with a clear
+    # error, not downstream in the consumer.
+    reparsed = json.loads(payload)
+    if reparsed.keys() != result.keys():
+        raise RuntimeError("bench JSON round-trip dropped keys: "
+                           f"{set(result) ^ set(reparsed)}")
+    with open(sidecar_path, "w") as f:
+        f.write(payload + "\n")
+    out(payload)
+    head = {k: result[k] for k in CONTRACT_KEYS if k in result}
+    head["full_payload_file"] = os.path.basename(sidecar_path)
+    head_line = json.dumps(head)
+    if len(head_line) > HEAD_LINE_BUDGET:
+        raise RuntimeError(
+            f"bench head line is {len(head_line)} bytes, over the "
+            f"{HEAD_LINE_BUDGET}-byte driver budget; trim CONTRACT_KEYS")
+    out(head_line)
+    return head
+
+
 def _enable_compilation_cache() -> None:
     """Persist XLA compilations (the matrix compiles six train-window
     programs, ~40 s each on TPU, identical across bench invocations)."""
@@ -558,7 +811,28 @@ def main(argv=None) -> None:
     p.add_argument("--max-iters", type=int, default=100,
                    help="minimum steady-state iterations per config")
     p.add_argument("--global-batch", type=int, default=256)
+    p.add_argument("--require-real-data", action="store_true",
+                   help="fail before measuring anything if CIFAR_DATA_DIR "
+                        "(default ./data) holds no real CIFAR-10 pickle "
+                        "batches — the right mode for any bench whose "
+                        "convergence numbers will be read as CIFAR-10 "
+                        "results (throughput is data-independent)")
+    p.add_argument("--full-out", default=None,
+                   help="path for the full-payload JSON sidecar (default: "
+                        "BENCH_FULL.json next to this script; the compact "
+                        "final-stdout-line head names it in "
+                        "full_payload_file)")
     args = p.parse_args(argv)
+
+    if args.require_real_data:
+        from cs744_ddp_tpu.data import cifar10
+        data_dir = os.environ.get("CIFAR_DATA_DIR", "./data")
+        if not cifar10.has_real_data(data_dir):
+            raise SystemExit(
+                f"--require-real-data: no CIFAR-10 pickle batches under "
+                f"{data_dir!r} (expected "
+                f"{data_dir}/cifar-10-batches-py/data_batch_*); refusing "
+                "to bench against the synthetic stand-in")
 
     _enable_compilation_cache()
     result = run_bench(matrix=not args.no_matrix, sweep=not args.no_sweep,
@@ -570,15 +844,8 @@ def main(argv=None) -> None:
                                           or args.no_matrix),
                        max_iters=args.max_iters,
                        global_batch=args.global_batch)
-    payload = json.dumps(result)
-    # Self-validate before emitting: the driver parses this single line, so
-    # a non-serializable value (numpy scalar, NaN under a strict parser)
-    # must fail HERE with a clear error, not downstream in the consumer.
-    reparsed = json.loads(payload)
-    if reparsed.keys() != result.keys():
-        raise RuntimeError("bench JSON round-trip dropped keys: "
-                           f"{set(result) ^ set(reparsed)}")
-    print(payload)
+    emit_result(result, args.full_out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_FULL.json"))
 
 
 if __name__ == "__main__":

@@ -48,6 +48,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-devices", type=int, default=None,
                    help="use only the first N local devices")
     p.add_argument("--data-dir", default="./data")
+    p.add_argument("--require-real-data", action="store_true",
+                   help="fail loudly if --data-dir holds no real CIFAR-10 "
+                        "pickle batches instead of silently training on the "
+                        "deterministic synthetic fallback (the right mode "
+                        "for any run whose accuracy numbers will be read "
+                        "as CIFAR-10 results)")
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", type=float, default=1e-4)
@@ -97,6 +103,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    if args.require_real_data:
+        from .data import cifar10
+        if not cifar10.has_real_data(args.data_dir):
+            raise SystemExit(
+                f"--require-real-data: no CIFAR-10 pickle batches under "
+                f"{args.data_dir!r} (expected "
+                f"{args.data_dir}/cifar-10-batches-py/data_batch_*); "
+                "refusing to fall back to the synthetic stand-in")
     meshlib.initialize_distributed(args.master, args.num_nodes, args.rank,
                                    port=args.port)
     telemetry = (Telemetry(args.telemetry_out)

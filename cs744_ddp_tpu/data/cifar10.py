@@ -73,6 +73,14 @@ def _synthetic_split(n: int, seed: int) -> Split:
     return Split(np.clip(images, 0, 255).astype(np.uint8), labels)
 
 
+def has_real_data(data_dir: str = "./data") -> bool:
+    """Would ``load`` find the real python-pickle batches here?  The ONE
+    check both ``--require-real-data`` surfaces (cli.py, bench.py) share
+    with the loader, so the flag can never disagree with what ``load``
+    actually does."""
+    return os.path.isdir(os.path.join(data_dir, "cifar-10-batches-py"))
+
+
 def load(data_dir: str = "./data") -> Tuple[Split, Split, bool]:
     """Return (train, test, is_real)."""
     batch_dir = os.path.join(data_dir, "cifar-10-batches-py")

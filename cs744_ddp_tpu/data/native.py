@@ -20,7 +20,7 @@ import numpy as np
 
 from .cifar10 import MEAN, STD
 
-_EXPECTED_VERSION = 2
+_EXPECTED_VERSION = 3
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -69,6 +69,8 @@ def load_library(build: bool = True) -> Optional[ctypes.CDLL]:
                                        f32p, f32p, ctypes.c_int]
         lib.fl_augment_u8.argtypes = [u8p, ctypes.c_int, i32p, u8p, u8p,
                                       ctypes.c_int]
+        lib.fl_gather_augment_u8.argtypes = [u8p, i64p, ctypes.c_int, i32p,
+                                             u8p, u8p, ctypes.c_int]
         lib.fl_normalize_f32.argtypes = [u8p, ctypes.c_int, f32p, f32p, f32p,
                                          ctypes.c_int]
         lib.fl_version.restype = ctypes.c_int
@@ -108,14 +110,22 @@ _MEAN32 = np.ascontiguousarray(MEAN, np.float32)
 _STD32 = np.ascontiguousarray(STD, np.float32)
 
 
-def gather(dataset: np.ndarray, indices: np.ndarray) -> np.ndarray:
-    """out[i] = dataset[indices[i]] for a [N,32,32,3] uint8 dataset."""
+def gather(dataset: np.ndarray, indices: np.ndarray,
+           out: Optional[np.ndarray] = None) -> np.ndarray:
+    """out[i] = dataset[indices[i]] for a [N,32,32,3] uint8 dataset.
+
+    ``out`` (uint8 [n,32,32,3], contiguous) receives the rows in place
+    (arena staging, same contract as ``augment_u8``)."""
     lib = load_library()
     if lib is None:
-        return dataset[indices]
+        if out is None:
+            return dataset[indices]
+        _check_out(out, len(indices))[...] = dataset[indices]
+        return out
     dataset = np.ascontiguousarray(dataset)
     idx = np.ascontiguousarray(indices, np.int64)
-    out = np.empty((len(idx), 32, 32, 3), np.uint8)
+    out = np.empty((len(idx), 32, 32, 3), np.uint8) if out is None \
+        else _check_out(out, len(idx))
     lib.fl_gather_u8(_ptr(dataset, ctypes.c_uint8), _ptr(idx, ctypes.c_int64),
                      len(idx), _ptr(out, ctypes.c_uint8), _nthreads())
     return out
@@ -151,20 +161,38 @@ def augment(images: np.ndarray, offsets: np.ndarray, flips: np.ndarray
     return out
 
 
-def augment_u8(images: np.ndarray, offsets: np.ndarray, flips: np.ndarray
-               ) -> np.ndarray:
+def _check_out(out: np.ndarray, n: int) -> np.ndarray:
+    """Validate a caller-provided staging destination: contiguous uint8
+    [n,32,32,3].  Never copies — the point of the out-parameter is writing
+    straight into a reusable arena slot."""
+    if out.shape != (n, 32, 32, 3) or out.dtype != np.uint8:
+        raise ValueError(f"out must be uint8 [{n},32,32,3], got "
+                         f"{out.dtype} {out.shape}")
+    if not out.flags.c_contiguous:
+        raise ValueError("out must be C-contiguous (an arena row, not a "
+                         "strided view)")
+    return out
+
+
+def augment_u8(images: np.ndarray, offsets: np.ndarray, flips: np.ndarray,
+               out: Optional[np.ndarray] = None) -> np.ndarray:
     """Pad-4 crop + flip, uint8 -> uint8 (zero padding, no normalize).
 
     The transfer-compact staging variant: the stochastic transform runs
     host-side; normalization is an affine per-channel map the device step
     fuses for free, so shipping uint8 carries 4x fewer bytes than the f32
-    ``augment`` output over the host->device link."""
+    ``augment`` output over the host->device link.
+
+    ``out`` (uint8 [n,32,32,3], contiguous) receives the result in place —
+    the chunked staging path passes arena rows here so no per-window stack
+    copy exists."""
     n = len(images)
     images = np.ascontiguousarray(images)
     offsets = np.ascontiguousarray(offsets, np.int32)
     flips = np.ascontiguousarray(flips, np.uint8)
     lib = load_library()
-    out = np.empty((n, 32, 32, 3), np.uint8)
+    out = np.empty((n, 32, 32, 3), np.uint8) if out is None \
+        else _check_out(out, n)
     if lib is None:
         padded = np.pad(images, ((0, 0), (4, 4), (4, 4), (0, 0)))
         for i in range(n):
@@ -177,6 +205,110 @@ def augment_u8(images: np.ndarray, offsets: np.ndarray, flips: np.ndarray
                       _ptr(flips, ctypes.c_uint8),
                       _ptr(out, ctypes.c_uint8), _nthreads())
     return out
+
+
+def gather_augment_u8(dataset: np.ndarray, indices: np.ndarray,
+                      offsets: np.ndarray, flips: np.ndarray,
+                      out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Fused gather + pad-4 crop + flip from the resident [N,32,32,3] u8
+    dataset straight into ``out`` (one host copy instead of the previous
+    gather -> augment -> np.stack three).  Same crop/flip semantics as
+    ``augment_u8(gather(dataset, indices), ...)`` — pinned elementwise by
+    tests/test_native.py."""
+    n = len(indices)
+    dataset = np.ascontiguousarray(dataset)
+    idx = np.ascontiguousarray(indices, np.int64)
+    offsets = np.ascontiguousarray(offsets, np.int32)
+    flips = np.ascontiguousarray(flips, np.uint8)
+    lib = load_library()
+    out = np.empty((n, 32, 32, 3), np.uint8) if out is None \
+        else _check_out(out, n)
+    if lib is None:
+        return augment_u8(dataset[idx], offsets, flips, out=out)
+    lib.fl_gather_augment_u8(_ptr(dataset, ctypes.c_uint8),
+                             _ptr(idx, ctypes.c_int64), n,
+                             _ptr(offsets, ctypes.c_int32),
+                             _ptr(flips, ctypes.c_uint8),
+                             _ptr(out, ctypes.c_uint8), _nthreads())
+    return out
+
+
+class StagingArena:
+    """Reusable chunk-aligned uint8 staging buffers for the chunked
+    windowed host-augment path (train/loop.py).
+
+    ``nslots`` preallocated [chunk_batches, batch, 32, 32, 3] buffers are
+    handed out round-robin by ``acquire()``; ``retire(slot, handle)``
+    records the device transfer sourced from a slot (any object with
+    ``block_until_ready``, i.e. a jax.Array), and the next ``acquire()`` of
+    that slot blocks until the recorded transfer completed before letting
+    the producer overwrite the host memory.
+
+    CAVEAT — the fence covers TRANSFER completion only.  On backends with
+    a real host->device link (TPU/GPU) the put copies into separate device
+    memory, so a completed transfer makes the host row safely rewritable
+    and correctness is independent of the slot count (it only sets how far
+    the producer runs ahead without stalling).  jax's CPU client instead
+    ALIASES suitably-aligned committed numpy buffers (verified empirically
+    — mutating the source after ``device_put`` + ``block_until_ready``
+    changes the jax array), so there ``retire`` CANNOT make reuse safe and
+    the caller must not stage zero-copy at all; Trainer probes the actual
+    behavior per backend+sharding (``_probe_put_aliases_host``) and puts
+    private copies of the rows where aliasing is detected.
+
+    The aliasing decision is PER BUFFER, not per backend: the CPU client
+    zero-copies only 64-byte-aligned arrays, and a long-lived process's
+    heap hands ``np.empty`` blocks of this size back at whatever alignment
+    the free lists hold (measured in-suite: the same arena with slots
+    [no, no, no, YES, YES, no]).  Every slot is therefore allocated at a
+    FORCED 64-byte alignment so all slots behave identically and a probe
+    of any one of them speaks for the arena; Trainer still probes every
+    slot (``StagingArena`` exposes them via ``buffer``) as defense in
+    depth."""
+
+    _ALIGN = 64  # jax CPU client's zero-copy alignment threshold
+
+    @classmethod
+    def _aligned_empty(cls, shape) -> np.ndarray:
+        n = int(np.prod(shape))
+        raw = np.empty(n + cls._ALIGN, np.uint8)
+        off = (-raw.ctypes.data) % cls._ALIGN
+        return raw[off:off + n].reshape(shape)
+
+    def __init__(self, nslots: int, chunk_batches: int, batch: int):
+        if nslots < 2:
+            raise ValueError(f"need >= 2 slots to overlap, got {nslots}")
+        self.chunk_batches = chunk_batches
+        self._bufs = [
+            self._aligned_empty((chunk_batches, batch, 32, 32, 3))
+            for _ in range(nslots)]
+        self._pending = [None] * nslots
+        self._next = 0
+
+    @property
+    def nslots(self) -> int:
+        return len(self._bufs)
+
+    def buffer(self, slot: int) -> np.ndarray:
+        """Direct access to a slot's backing buffer (aliasing probes,
+        tests); training code goes through ``acquire``."""
+        return self._bufs[slot]
+
+    def acquire(self):
+        """-> (slot_id, buffer): the next writable slot, after fencing any
+        in-flight transfer that still reads this slot's memory."""
+        i = self._next
+        self._next = (i + 1) % len(self._bufs)
+        dep = self._pending[i]
+        if dep is not None:
+            dep.block_until_ready()
+            self._pending[i] = None
+        return i, self._bufs[i]
+
+    def retire(self, slot: int, handle) -> None:
+        """Record the device array whose host->device transfer reads
+        ``slot``; the slot stays unwritable until it completes."""
+        self._pending[slot] = handle
 
 
 def normalize(images: np.ndarray) -> np.ndarray:

@@ -44,6 +44,26 @@ GLOBAL_BATCH = 256      # reference: batch_size=256 (Part 2a/main.py:173)
 SEED = 0                # reference: torch.manual_seed(0) (main.py:80-81)
 
 
+def _shard_batch_cols(n_examples: int, world: int, global_batch: int,
+                      epoch: int, *, shuffle: bool, seed: int = SEED,
+                      reshuffle_each_epoch: bool = False
+                      ) -> Iterator[np.ndarray]:
+    """Yield each global batch's device-major index columns (the sampler
+    layout ``_shard_batches`` materializes).  The chunked staging producer
+    consumes the RAW indices so the fused C++ gather+augment
+    (native.gather_augment_u8) can write arena rows straight from the
+    resident dataset, with no intermediate gathered batch."""
+    per = global_batch // world
+    idx = sharding.global_epoch_indices(
+        n_examples, world, seed=seed, shuffle=shuffle, epoch=epoch,
+        reshuffle_each_epoch=reshuffle_each_epoch)
+    nfull = idx.shape[1] // per
+    for b in range(nfull):
+        yield idx[:, b * per:(b + 1) * per].reshape(-1)  # device-major
+    if idx.shape[1] % per:
+        yield idx[:, nfull * per:].reshape(-1)
+
+
 def _shard_batches(split: cifar10.Split, world: int, global_batch: int,
                    epoch: int, *, shuffle: bool, seed: int = SEED,
                    reshuffle_each_epoch: bool = False
@@ -58,18 +78,11 @@ def _shard_batches(split: cifar10.Split, world: int, global_batch: int,
     equal-sized across ranks and shards cleanly; it runs through a second
     compiled step at its own (static) shape — exact short-batch BN/CE
     semantics, no masking."""
-    per = global_batch // world
-    idx = sharding.global_epoch_indices(
-        len(split.labels), world, seed=seed, shuffle=shuffle, epoch=epoch,
-        reshuffle_each_epoch=reshuffle_each_epoch)
-    nfull = idx.shape[1] // per
-    for b in range(nfull):
-        cols = idx[:, b * per:(b + 1) * per].reshape(-1)  # device-major
+    for cols in _shard_batch_cols(
+            len(split.labels), world, global_batch, epoch, shuffle=shuffle,
+            seed=seed, reshuffle_each_epoch=reshuffle_each_epoch):
         # Batch assembly via the native threaded gather (the reference's
         # DataLoader-worker equivalent); falls back to numpy fancy indexing.
-        yield native.gather(split.images, cols), split.labels[cols]
-    if idx.shape[1] % per:
-        cols = idx[:, nfull * per:].reshape(-1)
         yield native.gather(split.images, cols), split.labels[cols]
 
 
@@ -98,6 +111,7 @@ class Trainer:
                  sgd_cfg: sgd.SGDConfig = sgd.SGDConfig(),
                  profile_phases: bool = False,
                  host_augment: bool = False,
+                 host_chunks: int = 4,
                  precision: str = "f32",
                  reshuffle_each_epoch: bool = False,
                  limit_train_batches: Optional[int] = None,
@@ -127,6 +141,18 @@ class Trainer:
         # default (False) keeps the TPU-first design: uint8 to the device,
         # transform fused into the compiled step.
         self.host_augment = host_augment
+        # host_chunks: the windowed host-augment path stages each WINDOW as
+        # K sub-window chunks put_global'd individually by the producer, so
+        # window w+1's transfers overlap window w's device compute (round 6;
+        # the round-5 path shipped ONE blocking whole-window put and left
+        # the host->device link idle during compute — BASELINE.md pinned
+        # that 21% short of target).  K=1 degrades exactly to round 5's
+        # whole-window staging; default 4 keeps chunks ~5 batches (~3.8 MiB
+        # at B=256) — deep enough to overlap, coarse enough that per-put
+        # fixed costs stay amortized (bench.py chunk_sweep measures K).
+        if host_chunks < 1:
+            raise ValueError(f"host_chunks must be >= 1, got {host_chunks}")
+        self.host_chunks = int(host_chunks)
         # Compute precision: "f32" (reference parity, the default) or "bf16"
         # (mixed precision: f32 master weights/optimizer/BN statistics/loss,
         # bf16 conv+matmul activations — the MXU's native mode).
@@ -220,6 +246,28 @@ class Trainer:
         self._batch_sharding = meshlib.batch_sharding(self.mesh)
         from jax.sharding import NamedSharding, PartitionSpec as P
         self._epoch_sharding = NamedSharding(self.mesh, P(None, meshlib.DATA_AXIS))
+        if host_augment:
+            # On-device window assembly for the chunked staging path: ONE
+            # jitted concatenate over the K device-resident chunks (shared
+            # by images and labels; retraced per distinct arity/shape).  The
+            # u8 window copy it performs is ~15.7 MiB at W=20/B=256 —
+            # microseconds of HBM bandwidth against the link's ~15 ms/batch
+            # budget.  NEGATIVE RESULT (the rejected assembly variant):
+            # dispatching the scanned window per-chunk — or scanning across
+            # the chunk list — pays the tunneled backend's ~100 ms fixed
+            # dispatch latency PER CHUNK (measured: tools/perf_pieces.py,
+            # BASELINE.md "dispatch floor"), i.e. K x the cost round 5's
+            # windowing exists to amortize; and a K-argument fused
+            # scan-over-chunks program recompiles per distinct chunk-count
+            # signature while still serializing the window on its LAST
+            # chunk's arrival.  Concatenate-then-scan keeps one dispatch
+            # per window and lets earlier chunks transfer while the
+            # previous window computes.
+            self._assemble_chunks = jax.jit(
+                lambda *chunks: jnp.concatenate(chunks, axis=0),
+                out_shardings=self._epoch_sharding)
+        self._staging_arena = None          # lazily-built native.StagingArena
+        self._staging_put_copies = None     # backend aliasing probe result
         self._staged_train = None   # (epoch_images, epoch_labels, tail)
         self._staged_eval = None
         self._fwd_window = None     # built lazily by measure_phase_split
@@ -238,6 +286,7 @@ class Trainer:
                 "precision": precision,
                 "augment": augment,
                 "host_augment": host_augment,
+                "host_chunks": host_chunks,
                 "profile_phases": profile_phases,
                 "seed": seed,
                 "reshuffle_each_epoch": reshuffle_each_epoch,
@@ -613,15 +662,18 @@ class Trainer:
     # DataLoader keeps the same depth of completed batches ahead.
     PREFETCH_DEPTH = 2
 
-    def _prefetch_iter(self, fill):
+    def _prefetch_iter(self, fill, depth: Optional[int] = None):
         """Producer-thread prefetch scaffolding shared by both host-augment
         paths: runs ``fill(emit)`` on a daemon thread — ``emit(item)``
         enqueues and returns False once the consumer has gone away — and
-        yields the emitted items in order.  Every producer exit path
-        enqueues a sentinel (BaseException included) so the consumer can
-        never block forever; the consumer polls with a timeout and drains
-        the queue before declaring a dead producer sentinel-less."""
-        q: queue.Queue = queue.Queue(maxsize=self.PREFETCH_DEPTH)
+        yields the emitted items in order.  ``depth`` overrides the queue
+        bound (the chunked windowed path queues per-CHUNK items, so its
+        bound is two windows' worth of chunks rather than two windows).
+        Every producer exit path enqueues a sentinel (BaseException
+        included) so the consumer can never block forever; the consumer
+        polls with a timeout and drains the queue before declaring a dead
+        producer sentinel-less."""
+        q: queue.Queue = queue.Queue(maxsize=depth or self.PREFETCH_DEPTH)
         stop = threading.Event()
 
         def safe_put(item) -> bool:
@@ -705,62 +757,161 @@ class Trainer:
 
         return self._prefetch_iter(fill)
 
-    def _iter_host_windows(self, epoch: int):
-        """Windowed host-augment pipeline (VERDICT r4 item 5): the producer
-        thread gathers + C++-augments up to ``WINDOW`` consecutive batches
-        into ONE stacked uint8 staging buffer, device-puts it whole, and the
-        consumer dispatches one scanned window over it — the per-dispatch
-        tunnel latency and transfer fixed costs amortize over the window
-        exactly as the device path's windows do, while the transform stays
-        host-side C++ (the reference's DataLoader-worker model,
-        ``Part 1/main.py:96-101``).  Buffers are UINT8 (crop/flip host-
-        side, normalize fused into the device step): the path's roofline
-        is the host->device link, and uint8 quarters its traffic.
+    def _chunk_cap(self) -> int:
+        """Batches per staging chunk: WINDOW split into ``host_chunks``
+        equal transfers (ceil — the last chunk of a window may be ragged,
+        ``_chunk_plan``)."""
+        return -(-WINDOW // self.host_chunks)
 
-        Yields ``("win", (k, x[k,B,...]u8, y[k,B]))`` for full-batch
-        groups (k <= WINDOW) and ``("tail", (it, x, y))`` for the ragged
-        final batch (its own per-step f32 shape).  Batches are transformed
-        by ``_host_transform_u8`` with their ABSOLUTE iteration index, so
-        the crop/flip stream is bit-identical to the per-step path's."""
+    def _chunk_plan(self, w: int):
+        """The chunk sizes the streaming producer emits for a ``w``-batch
+        window: fixed-capacity chunks plus a ragged last.  Shared by the
+        producer's flush boundaries and the assembly-program warmup (a
+        skewed copy of this arithmetic would warm the wrong arity and pay
+        a mid-epoch compile)."""
+        cap = self._chunk_cap()
+        sizes = [cap] * (w // cap)
+        if w % cap:
+            sizes.append(w % cap)
+        return sizes
+
+    def _probe_put_aliases_host(self, buf: np.ndarray) -> bool:
+        """Does ``put_global`` of a committed numpy array on this backend
+        ALIAS the host memory instead of copying it?  jax's CPU client
+        zero-copies suitably-aligned numpy buffers straight into device
+        arrays — under aliasing, rewriting a retired arena row would
+        corrupt chunks already handed to the consumer, so the producer puts
+        a private copy there instead.  The copy only costs where no real
+        host->device link exists; exactly where one does (TPU/GPU), device
+        memory is separate, the put must copy, and the arena stays
+        zero-copy.  Probed EMPIRICALLY on an actual arena row (aliasing
+        depends on backend, sharding layout and buffer alignment, not just
+        the backend name)."""
+        before = int(buf.flat[0])
+        x = meshlib.put_global(buf, self._epoch_sharding)
+        jax.block_until_ready(x)
+        buf.flat[0] = np.uint8(before ^ 0xFF)
+        aliased = int(np.asarray(jax.device_get(x)).flat[0]) != before
+        buf.flat[0] = before
+        return aliased
+
+    def _chunk_arena(self, cap: int) -> native.StagingArena:
+        """The reusable chunk-aligned staging arena (built lazily; rebuilt
+        when the chunk shape changes, e.g. a test monkeypatching WINDOW).
+        First build also runs the backend aliasing probe that decides
+        zero-copy vs copied puts."""
+        arena = self._staging_arena
+        if arena is not None and arena.chunk_batches == cap:
+            return arena
+        # Slot budget: the prefetch queue holds up to two windows' worth of
+        # transferred chunks (_iter_host_window_chunks' depth) while one
+        # more fills; +2 margin so the producer only stalls on a genuinely
+        # full pipe, never on arena starvation.
+        chunks_per_window = len(self._chunk_plan(WINDOW))
+        self._staging_arena = native.StagingArena(
+            2 * chunks_per_window + 2, cap, self.global_batch)
+        # Probe EVERY slot: aliasing is a per-buffer property (the CPU
+        # client's 64-byte alignment criterion — StagingArena docstring),
+        # and one aliased slot among non-aliased ones corrupts the stream
+        # just as surely, so any aliasing at all flips the path to copies.
+        self._staging_put_copies = any(
+            self._probe_put_aliases_host(self._staging_arena.buffer(s))
+            for s in range(self._staging_arena.nslots))
+        return self._staging_arena
+
+    def _iter_host_window_chunks(self, epoch: int):
+        """Chunked, double-buffered windowed host-augment pipeline (round
+        6).  Round 5 staged each window as ONE blocking whole-window
+        ``put_global``: the host->device link idled while the previous
+        window computed, and BASELINE.md pinned the path 21% short of its
+        target naming exactly this lever.  Here the producer thread fills
+        chunk-aligned arena rows via the FUSED C++ gather+augment
+        (``native.gather_augment_u8`` — straight from the resident dataset
+        into the staging row, collapsing the former gather -> augment ->
+        np.stack three-copy chain to one) and ``put_global``s each chunk
+        individually, so window w+1's chunk transfers overlap the
+        consumer's dispatch of window w; the consumer reassembles the
+        device-resident chunks (``_assemble_chunks``) and dispatches the
+        scanned window exactly as round 5 did.  Buffers stay UINT8
+        (crop/flip host-side, normalize fused into the device step): the
+        path's roofline is the host->device link, and uint8 quarters its
+        traffic.
+
+        Yields ``("chunk", (k, x[k,B,...]u8, y[k,B]i32, last))`` — ``last``
+        marks a window boundary — and ``("tail", (it, x, y))`` for the
+        ragged final batch (its own per-step f32 shape, exactly as round
+        5).  Batches are augmented with their ABSOLUTE iteration index
+        (``_host_aug_params``), so the crop/flip stream is bit-identical to
+        the per-step and whole-window paths regardless of ``host_chunks``
+        or thread timing — pinned by tests/test_cli_and_profiling.py."""
+        cap = self._chunk_cap()
+        arena = self._chunk_arena(cap)   # probe runs pre-thread, main thread
+        nfull, _ = self._per_rank_batch_counts()
+        nlim = nfull if self.limit_train_batches is None \
+            else min(nfull, self.limit_train_batches)
+
         def fill(emit):
-            buf_x, buf_y = [], []
+            split = self.train_split
+            chunk_x = None       # arena row block for the chunk being filled
+            slot = -1
+            chunk_y: list = []
+            filled = 0           # full batches consumed toward windows
 
-            def flush() -> bool:
-                if not buf_x:
+            def flush(last: bool) -> bool:
+                nonlocal chunk_x, slot
+                k = len(chunk_y)
+                if k == 0:
                     return True
-                k = len(buf_x)
-                with self.telemetry.span("prefetch_put", window=k):
-                    x = meshlib.put_global(np.stack(buf_x),
+                with self.telemetry.span("chunk_put", batches=k, last=last):
+                    src = chunk_x[:k]
+                    if self._staging_put_copies:
+                        src = src.copy()
+                    x = meshlib.put_global(src, self._epoch_sharding)
+                    y = meshlib.put_global(np.asarray(chunk_y, np.int32),
                                            self._epoch_sharding)
-                    y = meshlib.put_global(
-                        np.stack(buf_y).astype(np.int32),
-                        self._epoch_sharding)
-                buf_x.clear()
-                buf_y.clear()
-                return emit(("win", (k, x, y)))
+                if not self._staging_put_copies:
+                    arena.retire(slot, x)
+                chunk_x, slot = None, -1
+                chunk_y.clear()
+                return emit(("chunk", (k, x, y, last)))
 
-            for it, (imgs, labs) in enumerate(_shard_batches(
-                    self.train_split, self.world, self.global_batch,
+            for it, cols in enumerate(_shard_batch_cols(
+                    len(split.labels), self.world, self.global_batch,
                     epoch, shuffle=True, seed=self.seed,
                     reshuffle_each_epoch=self.reshuffle_each_epoch)):
                 if self.limit_train_batches is not None and \
                         it >= self.limit_train_batches:
                     break
-                if imgs.shape[0] < self.global_batch:  # ragged tail (last)
-                    if not flush():
-                        return
+                if len(cols) < self.global_batch:   # ragged tail (last)
+                    if not flush(last=True):        # defensive: nlim
+                        return                      # boundary flushed it
                     emit(("tail", (it, *self._put_host_augmented(
-                        imgs, labs, epoch, it))))
+                        native.gather(split.images, cols),
+                        split.labels[cols], epoch, it))))
                     return
+                if chunk_x is None:
+                    slot, chunk_x = arena.acquire()
                 with self.telemetry.span("host_augment"):
-                    buf_x.append(self._host_transform_u8(
-                        imgs, len(labs), epoch, it))
-                buf_y.append(labs)
-                if len(buf_x) == WINDOW and not flush():
+                    row = chunk_x[len(chunk_y)]
+                    if self.augment:
+                        native.gather_augment_u8(
+                            split.images, cols,
+                            *self._host_aug_params(len(cols), epoch, it),
+                            out=row)
+                    else:
+                        native.gather(split.images, cols, out=row)
+                chunk_y.append(split.labels[cols])
+                filled += 1
+                boundary = filled % WINDOW == 0 or filled == nlim
+                if (len(chunk_y) == cap or boundary) and \
+                        not flush(last=boundary):
                     return
-            flush()
 
-        return self._prefetch_iter(fill)
+        # Per-CHUNK queue items: bound the pipe at two windows' worth of
+        # chunks — same two-windows-ahead depth round 5's PREFETCH_DEPTH=2
+        # gave whole-window items.
+        return self._prefetch_iter(
+            fill, depth=2 * len(self._chunk_plan(WINDOW)))
 
     def _per_rank_batch_counts(self):
         """(nfull, tail_per): full per-rank batch count and ragged per-rank
@@ -783,26 +934,28 @@ class Trainer:
         return shapes
 
     def _host_window_shapes(self):
-        """The window sizes _iter_host_windows will emit, computed
-        host-side so compiles can be warmed up front."""
+        """The window sizes _iter_host_window_chunks will close with a
+        ``last`` chunk, computed host-side so compiles can be warmed up
+        front."""
         nfull, _ = self._per_rank_batch_counts()
         if self.limit_train_batches is not None:
             nfull = min(nfull, self.limit_train_batches)
         return self._window_shape_set(nfull)
 
     def _train_model_host_windowed(self, epoch: int) -> WindowedTimers:
-        """Windowed host-augment epoch: scanned dispatches over staged
-        C++-augmented buffers (``_iter_host_windows``), the reference's
-        print/timing schedule.  The default host-augment mode since round
-        5 — the per-step path remains under ``profile_phases`` (where
-        per-batch dispatch is the point)."""
+        """Windowed host-augment epoch: scanned dispatches over
+        chunk-staged C++-augmented buffers (``_iter_host_window_chunks``),
+        the reference's print/timing schedule.  The default host-augment
+        mode since round 5 — the per-step path remains under
+        ``profile_phases`` (where per-batch dispatch is the point)."""
         if self.telemetry.enabled:
             self._emit_collective_telemetry()
         timers = WindowedTimers(self.log, telemetry=self.telemetry,
                                 epoch=epoch)
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
         self._warm_per_step_tail_shapes()
-        # Warm the window compiles so none lands inside a timed window.
+        # Warm the window + assembly compiles so none lands inside a timed
+        # window.
         for w in self._host_window_shapes():
             cache_key = ("host", w, self.global_batch)
             if cache_key not in self._warmed_window_shapes:
@@ -819,18 +972,35 @@ class Trainer:
                         self.state, key, x_sds, y_sds, jnp.int32(0),
                         jnp.zeros((w,), jnp.int8)).compile()
                 self._warmed_window_shapes.add(cache_key)
-        for kind, payload in self._iter_host_windows(epoch):
-            if kind == "win":
-                k, x, y = payload
-                t0 = time.time()
-                self.state, losses = self.train_window_host(
-                    self.state, key, x, y, jnp.int32(0),
-                    jnp.zeros((k,), jnp.int8))
-                losses = np.asarray(losses)  # value fetch = fence
-                per_iter = (time.time() - t0) / k
-                for loss in losses:
-                    timers.record(float(loss), per_iter)
-            else:  # ragged tail through its own per-step shape
+            pattern = tuple(self._chunk_plan(w))
+            if len(pattern) > 1:
+                akey = ("assemble", pattern, self.global_batch)
+                if akey not in self._warmed_window_shapes:
+                    def _sds(c, trailing, dtype):
+                        return jax.ShapeDtypeStruct(
+                            (c, self.global_batch) + trailing, dtype,
+                            sharding=self._epoch_sharding)
+                    with self.telemetry.span("compile_warmup",
+                                             program="assemble_chunks",
+                                             chunks=len(pattern)):
+                        self._assemble_chunks.lower(
+                            *[_sds(c, (32, 32, 3), jnp.uint8)
+                              for c in pattern]).compile()
+                        self._assemble_chunks.lower(
+                            *[_sds(c, (), jnp.int32)
+                              for c in pattern]).compile()
+                    self._warmed_window_shapes.add(akey)
+        chunk_iter = self._iter_host_window_chunks(epoch)
+        chunks_x, chunks_y = [], []
+        while True:
+            # chunk_wait: how long the consumer stalls on the producer —
+            # with healthy overlap this is ~0 except at the first window.
+            with self.telemetry.span("chunk_wait"):
+                item = next(chunk_iter, None)
+            if item is None:
+                break
+            kind, payload = item
+            if kind == "tail":   # ragged tail through its own per-step shape
                 it, x, y = payload
                 t0 = time.time()
                 self.state, loss = self.train_step_host(
@@ -839,6 +1009,33 @@ class Trainer:
                 # steady=False: lone per-dispatch sample carries the fixed
                 # dispatch latency the amortized window samples do not.
                 timers.record(loss, time.time() - t0, steady=False)
+                continue
+            k, x, y, last = payload
+            chunks_x.append(x)
+            chunks_y.append(y)
+            if self.telemetry.enabled:
+                self.telemetry.gauge("window_chunks_pending", len(chunks_x))
+            if not last:
+                continue
+            # Window boundary: assemble the device-resident chunks and
+            # dispatch ONE scanned window, exactly as round 5 (a
+            # single-chunk window skips the concatenate — the K=1
+            # degenerate case IS round 5's whole-window path).
+            if len(chunks_x) == 1:
+                xw, yw = chunks_x[0], chunks_y[0]
+            else:
+                xw = self._assemble_chunks(*chunks_x)
+                yw = self._assemble_chunks(*chunks_y)
+            chunks_x, chunks_y = [], []
+            w = int(xw.shape[0])
+            t0 = time.time()
+            self.state, losses = self.train_window_host(
+                self.state, key, xw, yw, jnp.int32(0),
+                jnp.zeros((w,), jnp.int8))
+            losses = np.asarray(losses)  # value fetch = fence
+            per_iter = (time.time() - t0) / w
+            for loss in losses:
+                timers.record(float(loss), per_iter)
         self.last_epoch_timers = timers
         return timers
 

@@ -135,6 +135,44 @@ void fl_augment_u8(const uint8_t* images, int n, const int32_t* offsets,
   });
 }
 
+// Fused gather + pad-4 crop + optional flip, uint8 in/out: one pass from
+// the resident dataset straight into a caller-provided staging slot
+// (cs744_ddp_tpu/data/native.py StagingArena).  The windowed host-augment
+// path previously ran gather (copy 1) -> fl_augment_u8 into a fresh batch
+// (copy 2) -> np.stack into the window buffer (copy 3) before the
+// host->device put; this entry point collapses all three host copies into
+// one, with `out` pointing directly at the chunk-aligned arena row.
+void fl_gather_augment_u8(const uint8_t* dataset, const int64_t* indices,
+                          int n, const int32_t* offsets, const uint8_t* flips,
+                          uint8_t* out, int nthreads) {
+  parallel_for_images(n, nthreads, [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      const uint8_t* img = dataset + (size_t)indices[i] * kImg;
+      uint8_t* dst = out + (size_t)i * kImg;
+      const int oy = offsets[2 * i], ox = offsets[2 * i + 1];
+      const bool flip = flips[i] != 0;
+      for (int y = 0; y < kH; ++y) {
+        const int sy = y + oy - kPad;
+        if (sy < 0 || sy >= kH) {
+          std::memset(dst + (size_t)y * kW * kC, 0, kW * kC);
+          continue;
+        }
+        for (int x = 0; x < kW; ++x) {
+          const int xx = flip ? (kW - 1 - x) : x;
+          const int sx = xx + ox - kPad;
+          uint8_t* px = dst + ((size_t)y * kW + x) * kC;
+          if (sx < 0 || sx >= kW) {
+            px[0] = px[1] = px[2] = 0;
+          } else {
+            const uint8_t* sp = img + ((size_t)sy * kW + sx) * kC;
+            px[0] = sp[0]; px[1] = sp[1]; px[2] = sp[2];
+          }
+        }
+      }
+    }
+  });
+}
+
 // Normalize only (the test transform: ToTensor + Normalize, main.py:91-93).
 void fl_normalize_f32(const uint8_t* images, int n, const float* mean,
                       const float* std_, float* out, int nthreads) {
@@ -152,6 +190,6 @@ void fl_normalize_f32(const uint8_t* images, int n, const float* mean,
   });
 }
 
-int fl_version() { return 2; }  // 2: + fl_augment_u8
+int fl_version() { return 3; }  // 2: + fl_augment_u8; 3: + fl_gather_augment_u8
 
 }  // extern "C"

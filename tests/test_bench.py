@@ -6,6 +6,9 @@ and the 1..N-device scaling sweep produce well-formed, internally-consistent
 results (VERDICT r1 item 3).
 """
 
+import json
+import os
+
 import numpy as np
 import pytest
 
@@ -32,6 +35,7 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
                              global_batch=64, models=("tiny",),
                              strategies=("allreduce", "ddp"),
                              deep_rows=(("tiny", "gather"),),
+                             spectrum_deep_rows=(("tiny", "gather"),),
                              headline_model="tiny",
                              peak_batch_candidates=(8, 16),
                              log=lambda s: None)
@@ -49,25 +53,41 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
 
     # Strategy x model matrix: one positive entry per pair, plus the
     # deep-model rows appended beyond the cross (VERDICT r4 item 7; the
-    # real run's deep_rows are vgg19/ddp and resnet34/ddp).
+    # real run's deep_rows are vgg19/ddp and resnet34/ddp) and one bf16
+    # row for the last deep pair at the parity batch.
     assert set(result["matrix"]) == {"tiny/allreduce", "tiny/ddp",
-                                     "tiny/gather"}
+                                     "tiny/gather", "tiny/gather/bf16"}
     assert all(v["images_per_sec_per_chip"] > 0
                for v in result["matrix"].values())
+    assert result["matrix"]["tiny/gather/bf16"]["precision"] == "bf16"
 
     # Peak entry: bf16 frontier config, well-formed and positive.
     assert result["peak"]["images_per_sec_per_chip"] > 0
     assert "bf16" in result["peak"]["config"]
 
-    # Host-pipeline entry: windowed --host-augment throughput, tracked so
-    # the round-5 7.9x win cannot silently regress (BASELINE.md).
-    assert result["host_pipeline"]["images_per_sec_per_chip"] > 0
+    # Host-pipeline entry: chunked windowed --host-augment throughput,
+    # tracked so the round-5 7.9x win cannot silently regress (BASELINE.md).
+    hp = result["host_pipeline"]
+    assert hp["images_per_sec_per_chip"] > 0
+    assert hp["host_chunks"] >= 1
+    # Chunk sweep covers the default K plus the 1/2/8 controls (K=1 is
+    # round 5's whole-window staging), each a positive rate.
+    assert set(hp["chunk_sweep"]) == {str(hp["host_chunks"]), "1", "2", "8"}
+    assert all(v > 0 for v in hp["chunk_sweep"].values())
+    # Link floor: the pure-device_put ceiling, both byte distributions
+    # (real-entropy leg comes from the committed tests/assets fixture).
+    lf = hp["link_floor"]
+    assert lf["synthetic"]["floor_images_per_sec_per_chip"] > 0
+    assert lf["real_entropy"]["floor_images_per_sec_per_chip"] > 0
+    assert 0 < lf["real_entropy"]["unique_mib"] < lf["buffer_mib"]
     # Attached in-memory telemetry summary: the section trains real epochs,
-    # so step events and host_augment/prefetch_put spans must be there.
-    hts = result["host_pipeline"]["telemetry_summary"]
+    # so step events and host_augment/chunk_put/chunk_wait spans must be
+    # there (chunk_put replaced prefetch_put for full batches in this PR).
+    hts = hp["telemetry_summary"]
     assert hts["num_steps"] > 0
     assert "host_augment" in hts["spans"]
-    assert "prefetch_put" in hts["spans"]
+    assert "chunk_put" in hts["spans"]
+    assert "chunk_wait" in hts["spans"]
 
     # Convergence entries: the reference's own correctness signal (VERDICT
     # r4 item 3).  On this toolchain's init draw the reference lr=0.1 lands
@@ -135,12 +155,103 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
         assert per["allreduce"]["total_count"] > per["ddp"]["total_count"]
         assert per["gather"]["total_result_mib"] > \
             per["allreduce"]["total_result_mib"]
+        # Deep-model rows (real run: resnet34 allreduce+ddp) ride in their
+        # own sub-dict so per_strategy keeps its tier-only shape.
+        deep = result["spectrum"]["deep_rows"]
+        assert set(deep) == {"tiny/gather"}
+        assert deep["tiny/gather"]["total_count"] >= 1
+        assert deep["tiny/gather"]["grad_mib"] > 0
 
-    # JSON-serializable single line (the driver contract).
+    # Emission contract: full payload (stdout line + sidecar) first, the
+    # compact head LAST — the driver JSON-parses the final line of a
+    # ~2000-byte stdout tail, which the full payload overflowed in rounds
+    # 4/5 ("parsed": null in BENCH_r04/r05.json).
     import json
-    line = json.dumps(result)
-    assert "\n" not in line
-    assert json.loads(line) == result
+    sidecar = tmp_path / "BENCH_FULL.json"
+    lines = []
+    head = bench.emit_result(result, str(sidecar), out=lines.append)
+    assert len(lines) == 2
+    assert json.loads(lines[0]) == result                 # full, first
+    assert json.loads(lines[1]) == head                   # head, LAST
+    assert len(lines[1]) <= bench.HEAD_LINE_BUDGET
+    assert head["full_payload_file"] == "BENCH_FULL.json"
+    assert head["value"] == result["value"]
+    assert head["headline_stats"] == result["headline_stats"]
+    assert json.loads(sidecar.read_text()) == result      # auditable copy
+
+
+def test_matrix_pairs_prunes_world1_strategy_cross():
+    models = ("vgg11", "resnet18")
+    strategies = ("gather", "allreduce", "ddp")
+    deep = (("vgg19", "ddp"), ("resnet34", "ddp"))
+    # Multi-chip: the full cross plus the deep rows, in order.
+    assert bench._matrix_pairs(8, models, strategies, deep) == \
+        [(m, s) for m in models for s in strategies] + list(deep)
+    # world=1: every strategy's sync is a no-op, so the cross is pruned to
+    # one strategy per model (BASELINE.md "1-chip strategy matrix").
+    assert bench._matrix_pairs(1, models, strategies, deep) == \
+        [("vgg11", "ddp"), ("resnet18", "ddp"),
+         ("vgg19", "ddp"), ("resnet34", "ddp")]
+    # No "ddp" on offer -> the first offered strategy is kept; deep rows
+    # already in the cross are not duplicated.
+    assert bench._matrix_pairs(1, ("vgg11",), ("gather",),
+                               (("vgg11", "gather"),)) == \
+        [("vgg11", "gather")]
+
+
+def test_emit_result_contract_and_head_budget(tmp_path, capsys):
+    result = {"metric": "m", "value": 1.5, "unit": "u", "vs_baseline": 2.0,
+              "num_devices": 8,
+              "headline_stats": {"runs": [1.5], "best": 1.5},
+              "tflops_per_sec": 0.5, "mfu_vs_bf16_peak": 0.01,
+              "matrix": {"big": "x" * 4000}}   # bulk the head must exclude
+    sidecar = tmp_path / "FULL.json"
+    head = bench.emit_result(result, str(sidecar))   # default out=print
+    cap = capsys.readouterr().out.strip().splitlines()
+    assert len(cap) == 2
+    assert json.loads(cap[0]) == result               # full payload first
+    assert json.loads(cap[-1]) == head                # compact head LAST
+    assert len(cap[-1]) <= bench.HEAD_LINE_BUDGET
+    assert head["full_payload_file"] == "FULL.json"
+    assert "matrix" not in head
+    assert json.loads(sidecar.read_text()) == result  # auditable sidecar
+    # A head that cannot fit the driver's tail capture must fail loudly
+    # instead of reintroducing the r04/r05 parsed-null failure.
+    huge = dict(result, metric="m" * 2 * bench.HEAD_LINE_BUDGET)
+    with pytest.raises(RuntimeError, match="budget"):
+        bench.emit_result(huge, str(sidecar), out=lambda s: None)
+
+
+def test_bench_require_real_data_gate(tmp_path, monkeypatch):
+    # No pickle batches under the data dir -> refuse before measuring.
+    monkeypatch.setenv("CIFAR_DATA_DIR", str(tmp_path))
+    with pytest.raises(SystemExit, match="require-real-data"):
+        bench.main(["--require-real-data"])
+    # The committed CIFAR fixture satisfies the gate; with run_bench
+    # stubbed, main() emits per contract into --full-out.
+    monkeypatch.setenv("CIFAR_DATA_DIR",
+                       os.path.join(os.path.dirname(__file__), "assets"))
+    monkeypatch.setattr(bench, "run_bench", lambda **kw: {
+        "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+        "num_devices": 1, "headline_stats": {"runs": [1.0]}})
+    monkeypatch.setattr(bench, "_enable_compilation_cache", lambda: None)
+    out = tmp_path / "SIDE.json"
+    bench.main(["--require-real-data", "--full-out", str(out)])
+    assert json.loads(out.read_text())["metric"] == "m"
+
+
+def test_measure_link_floor_both_legs():
+    """Fast harness check on the CPU mesh: both byte-distribution legs
+    present and positive (the numbers only mean something on tpu — the
+    backend label records that)."""
+    lf = bench.measure_link_floor(lambda s: None, global_batch=64, ndev=8,
+                                  trials=1)
+    assert lf["backend"] == "cpu"
+    assert lf["synthetic"]["floor_images_per_sec_per_chip"] > 0
+    assert lf["synthetic"]["mib_per_s"] > 0
+    real = lf["real_entropy"]   # committed tests/assets fixture
+    assert real["floor_images_per_sec_per_chip"] > 0
+    assert 0 < real["unique_mib"] < lf["buffer_mib"]
 
 
 @pytest.mark.slow  # ~60s: two full-model cost analyses

@@ -187,6 +187,12 @@ def test_null_recorder_makes_no_writes_and_holds_no_state(monkeypatch):
     assert opened == []                            # zero file writes
     # The span context manager is a shared singleton — no per-call alloc.
     assert NULL.span("a") is NULL.span("b") is _NULL_SPAN
+    # The chunked-staging spans ride the same path: attrs must not force
+    # an allocation either (the producer thread calls these per chunk).
+    assert NULL.span("chunk_put", batches=3, last=True) is _NULL_SPAN
+    assert NULL.span("chunk_wait") is _NULL_SPAN
+    NULL.gauge("window_chunks_pending", 2)         # still zero writes
+    assert opened == []
 
 
 def test_git_sha_returns_repo_head():
@@ -292,15 +298,24 @@ def test_trainer_host_augment_pipeline_telemetry(tmp_path, mesh4):
     for s in spans:
         by_name.setdefault(s["name"], []).append(s)
     # Producer-thread work is visible: the stochastic transform and the
-    # handoff into the bounded queue.
+    # per-chunk device puts (chunk_put superseded prefetch_put for staged
+    # full batches when staging went chunked; prefetch_put remains on the
+    # per-step tail path only).
     assert by_name["host_augment"]
-    assert by_name["prefetch_put"]
+    assert by_name["chunk_put"]
+    assert all(s["batches"] >= 1 for s in by_name["chunk_put"])
+    assert any(s["last"] for s in by_name["chunk_put"])  # window boundary
     # The producer thread has its own span stack: these are top-level.
     assert all(s["depth"] == 0 for s in by_name["host_augment"])
-    # Consumer-side pipeline gauge.
+    assert all(s["depth"] == 0 for s in by_name["chunk_put"])
+    # Consumer-side stall probe + pipeline gauges.
+    assert by_name["chunk_wait"]
     depths = [r["value"] for r in tel.records
               if r["kind"] == "gauge" and r["name"] == "prefetch_queue_depth"]
     assert depths and all(d >= 0 for d in depths)
+    pending = [r["value"] for r in tel.records
+               if r["kind"] == "gauge" and r["name"] == "window_chunks_pending"]
+    assert pending and all(p >= 1 for p in pending)
 
 
 # -- CLI end to end -----------------------------------------------------------

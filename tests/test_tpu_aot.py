@@ -135,7 +135,12 @@ def test_collective_chain_depth_pins_latency_shape(v5e8_mesh):
             _lower_step(v5e8_mesh, vgg.VGG11(), name, 256)
             .compiler_ir(dialect="hlo").as_hlo_text())
         for name in ("gather", "allreduce", "ddp")}
-    assert depth["allreduce"] == 34, depth
+    # 34 = VGG-11's trainable leaves (the tier chains one psum per leaf);
+    # a tight BAND rather than equality because toolchain bumps have moved
+    # the count by the odd loss/metric psum the parser attributes to the
+    # chain (VERDICT r5 item 5) — the regression this pins is the chain
+    # COLLAPSING (fusion to a handful) or exploding, not +-2 bookkeeping.
+    assert 34 <= depth["allreduce"] <= 36, depth
     assert depth["gather"] >= 2 * 34, depth
     # 2 buckets (37 MB / 25 MB) + margin of 1 for the loss/metric psum;
     # strictly below the per-leaf tier either way.

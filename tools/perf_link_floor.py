@@ -1,0 +1,38 @@
+"""Host->device link-goodput floor for the chunked staging path.
+
+Builder's tool: runs ``bench.measure_link_floor`` standalone — pure
+``put_global`` of WINDOW-sized uint8 staging buffers (the exact
+shape/sharding train/loop.py's producer ships) on (a) the synthetic split's
+compressible bytes and (b) real-entropy CIFAR-10 bytes from the committed
+``tests/assets`` fixture, tiled.  The floor is the images/sec/chip CEILING
+for the host-augment pipeline on this backend; BASELINE.md's host-pipeline
+target is stated as a fraction of it (VERDICT r5 item 3).
+
+Run on the bench host: ``python tools/perf_link_floor.py [global_batch]``.
+The same measurement rides inside every full bench run
+(``bench.py`` -> ``host_pipeline.link_floor``); this wrapper exists for
+iterating on the staging path without paying for a full bench.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+
+    import bench
+
+    global_batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    ndev = len(jax.devices())
+    floor = bench.measure_link_floor(
+        lambda s: print(s, file=sys.stderr),
+        global_batch=global_batch, ndev=ndev)
+    print(json.dumps(floor, indent=2))
+
+
+if __name__ == "__main__":
+    main()
