@@ -46,7 +46,13 @@ tests pin both.  The full payload carries
     pure-``device_put`` LINK FLOOR on synthetic and real-entropy bytes
     (``measure_link_floor``) so the path's target is a fraction of
     measured hardware rather than a round number, plus a ``chunk_sweep``
-    over the staging chunk count K.
+    over the staging chunk count K, and
+  * ``robustness`` — the fault-tolerance layer's cost/benefit sheet
+    (``run_robustness``): non-finite-guard throughput overhead, the
+    degraded synchronous staging fallback as a fraction of the healthy
+    chunked pipeline, emergency mid-epoch checkpoint save/restore wall
+    clock with the steps-lost accounting, and a deterministic
+    chaos-injected NaN-skip demo.
 
 Protocol (BASELINE.md): the reference's own measurement design — windowed
 wall-clock fenced by fetching the loss values, the first window (compile +
@@ -377,10 +383,161 @@ def _collect_spectrum(log, model: str, global_batch: int,
     return out
 
 
+def run_robustness(log, *, headline_model: str = "vgg11",
+                   headline_strategy=None, ndev=None,
+                   global_batch: int = 256, data_dir: str = "./data",
+                   max_iters: int = 100) -> dict:
+    """Fault-tolerance cost/benefit numbers for the ft/ layer, measured:
+
+    * ``guard_overhead`` — steady-state throughput with the non-finite
+      step guard compiled in (``nonfinite="skip"``) vs the unguarded
+      program.  The guard adds an on-device finiteness check of loss +
+      global grad sqnorm and a per-leaf select to every step; this is the
+      price of never applying a poisoned update.
+    * ``staging`` — the degraded synchronous staging fallback (what a
+      doubly-failed producer leaves you with) vs the healthy chunked
+      pipeline, on the ``--host-augment`` path.  The fallback ships the
+      bit-identical batch stream (tests/test_ft.py pins it), so this ratio
+      is the whole cost of losing the producer thread.
+    * ``checkpoint`` — emergency mid-epoch save + restore wall clock (what
+      a SIGTERM costs on the way down and the way back up), plus the
+      steps-lost accounting: step-level checkpoints replay 0 steps,
+      epoch-only checkpointing replays everything since the last epoch
+      boundary (worst case one full epoch).
+    * ``nonfinite_skip`` — end-to-end demo: a deterministically injected
+      NaN gradient (chaos ``nonfinite_grad``) under the skip policy;
+      records the skip count and that the run finishes finite.
+
+    Standalone-callable (the committed artifact's robustness section can be
+    refreshed without re-running the day-long throughput sections)."""
+    import tempfile
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from cs744_ddp_tpu.ft import ChaosPlan, FTConfig
+    from cs744_ddp_tpu.utils.metrics import WINDOW
+
+    log = log or (lambda s: print(s, file=sys.stderr))
+    ndev = ndev or len(jax.devices())
+    headline_strategy = headline_strategy or ("ddp" if ndev > 1 else "single")
+    out = {
+        "backend": jax.default_backend(),
+        "model": f"{headline_model}/{headline_strategy}",
+        "global_batch": global_batch,
+    }
+
+    # Guard overhead: same measurement design as the matrix (epoch-length
+    # windows, best-of-2 on one staged trainer).  NOTE the guarded program
+    # is a DIFFERENT compiled program (the check + select change XLA's
+    # fusion), so the comparison is throughput-vs-throughput, not
+    # bitwise-vs.
+    # Bounded epoch: the guard ratio stabilizes within a couple of windows,
+    # so the "epoch" each dispatch covers is capped at max_iters batches —
+    # still one dispatch per pass (the dispatch-latency amortization the
+    # epoch-window design exists for), without the full-epoch runtime.
+    guard_lim = max(max_iters, 2 * WINDOW)
+
+    def _ips(ft):
+        tr = _make_trainer(headline_model, headline_strategy, ndev,
+                           global_batch=global_batch, data_dir=data_dir,
+                           log=lambda s: None,
+                           limit_train_batches=guard_lim, ft=ft)
+        return max(tr.steady_state_throughput(
+                       max_iters=max_iters, window_iters="epoch")[1]
+                   for _ in range(2))
+
+    log("[bench] robustness: guard overhead (nonfinite=skip vs off)")
+    base_ips = _ips(None)
+    guard_ips = _ips(FTConfig(nonfinite="skip"))
+    out["guard_overhead"] = {
+        "unguarded_images_per_sec_per_chip": round(base_ips, 2),
+        "guarded_images_per_sec_per_chip": round(guard_ips, 2),
+        "guard_cost_pct": round((1.0 - guard_ips / base_ips) * 100.0, 2),
+    }
+
+    # Degraded vs healthy staging on the host-augment path.  Short cap:
+    # the ratio stabilizes within a couple of windows and the degraded
+    # path is serial by construction.
+    lim = min(max_iters, 49)
+
+    def _host_ips(ft):
+        tr = _make_trainer(headline_model, headline_strategy, ndev,
+                           global_batch=global_batch, data_dir=data_dir,
+                           log=lambda s: None, host_augment=True,
+                           limit_train_batches=lim, ft=ft)
+        nfull, tail_per = tr._per_rank_batch_counts()
+        images = (min(lim, nfull) * global_batch
+                  + (tail_per * tr.world if lim > nfull and tail_per else 0))
+        tr.train_model(0)   # compile + warm
+        best = 0.0
+        for _ in range(2):
+            t0 = _time.time()
+            tr.train_model(0)
+            best = max(best, images / (_time.time() - t0))
+        return best / ndev
+
+    log("[bench] robustness: staging healthy vs degraded (host-augment)")
+    healthy = _host_ips(None)
+    degraded = _host_ips(FTConfig(degrade_staging=True))
+    out["staging"] = {
+        "limit_train_batches": lim,
+        "healthy_images_per_sec_per_chip": round(healthy, 2),
+        "degraded_images_per_sec_per_chip": round(degraded, 2),
+        "degraded_fraction_of_healthy": round(degraded / healthy, 3),
+    }
+
+    # Emergency-checkpoint wall clock: what going down (save) and coming
+    # back (restore) cost, on the real model state; plus the replay
+    # accounting that motivates step-level checkpoints at all.
+    log("[bench] robustness: emergency checkpoint save/restore wall clock")
+    from cs744_ddp_tpu.train.checkpoint import CheckpointManager
+    tr = _make_trainer(headline_model, headline_strategy, ndev,
+                       global_batch=global_batch, data_dir=data_dir,
+                       log=lambda s: None)
+    nbatches, _ = tr._per_rank_batch_counts()
+    with tempfile.TemporaryDirectory() as ckdir:
+        mngr = CheckpointManager(ckdir)
+        try:
+            t0 = _time.time()
+            mngr.save_mid_epoch(0, nbatches // 2, tr.state)
+            save_s = _time.time() - t0
+            t0 = _time.time()
+            mngr.restore_mid_epoch(tr.state)
+            restore_s = _time.time() - t0
+        finally:
+            mngr.close()
+    out["checkpoint"] = {
+        "emergency_save_s": round(save_s, 3),
+        "mid_epoch_restore_s": round(restore_s, 3),
+        "steps_lost_with_step_ckpt": 0,
+        "steps_lost_epoch_only_worst_case": nbatches,
+    }
+
+    # End-to-end skip-policy demo: one window with a NaN gradient injected
+    # at an exact step — the update is dropped, the run stays finite.
+    log("[bench] robustness: non-finite skip demo (chaos nonfinite_grad:2)")
+    trg = _make_trainer(headline_model, headline_strategy, ndev,
+                        global_batch=global_batch, data_dir=data_dir,
+                        log=lambda s: None, limit_train_batches=WINDOW,
+                        ft=FTConfig(nonfinite="skip",
+                                    chaos=ChaosPlan.parse(
+                                        ["nonfinite_grad:2"])))
+    timers = trg.train_model(0)
+    out["nonfinite_skip"] = {
+        "chaos": "nonfinite_grad:2",
+        "updates_skipped": trg._epoch_nf_skipped,
+        "final_loss_finite": bool(np.isfinite(timers.losses[-1])),
+    }
+    return out
+
+
 def run_bench(*, matrix: bool = True, sweep: bool = True,
               peak: bool = True, convergence: bool = True,
               convergence_epochs: int = 3,
               spectrum: bool = True, host_pipeline: bool = True,
+              robustness: bool = True,
               max_iters: int = 100,
               global_batch: int = 256,
               models=MODELS, strategies=STRATEGIES, deep_rows=DEEP_ROWS,
@@ -671,6 +828,15 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
                 global_batch=global_batch),
         }
 
+    # Fault-tolerance cost/benefit: guard overhead, degraded-staging
+    # fraction, emergency checkpoint wall clock, skip-policy demo.
+    if robustness:
+        result["robustness"] = run_robustness(
+            log, headline_model=headline_model,
+            headline_strategy=headline_strategy, ndev=ndev,
+            global_batch=global_batch, data_dir=data_dir,
+            max_iters=max_iters)
+
     if sweep:
         # WEAK scaling: per-chip batch held at ``global_batch`` while the
         # mesh grows (global = global_batch x n).  The north star is
@@ -767,8 +933,17 @@ def emit_result(result: dict, sidecar_path: str, out=print) -> dict:
     if reparsed.keys() != result.keys():
         raise RuntimeError("bench JSON round-trip dropped keys: "
                            f"{set(result) ^ set(reparsed)}")
-    with open(sidecar_path, "w") as f:
-        f.write(payload + "\n")
+    # Atomic sidecar publish: a bench killed (or preempted) mid-write must
+    # leave the previous BENCH_FULL.json intact, never a torn one — the
+    # committed artifact is read by drivers and tests.
+    tmp = f"{sidecar_path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(payload + "\n")
+        os.replace(tmp, sidecar_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     out(payload)
     head = {k: result[k] for k in CONTRACT_KEYS if k in result}
     head["full_payload_file"] = os.path.basename(sidecar_path)
@@ -808,6 +983,10 @@ def main(argv=None) -> None:
                         "section (v5e-8 AOT lowering)")
     p.add_argument("--no-host-pipeline", action="store_true",
                    help="skip the windowed --host-augment throughput entry")
+    p.add_argument("--no-robustness", action="store_true",
+                   help="skip the fault-tolerance cost/benefit section "
+                        "(guard overhead, degraded staging, emergency "
+                        "checkpoint timing, skip-policy demo)")
     p.add_argument("--max-iters", type=int, default=100,
                    help="minimum steady-state iterations per config")
     p.add_argument("--global-batch", type=int, default=256)
@@ -842,6 +1021,8 @@ def main(argv=None) -> None:
                        spectrum=not (args.no_spectrum or args.no_matrix),
                        host_pipeline=not (args.no_host_pipeline
                                           or args.no_matrix),
+                       robustness=not (args.no_robustness
+                                       or args.no_matrix),
                        max_iters=args.max_iters,
                        global_batch=args.global_batch)
     emit_result(result, args.full_out or os.path.join(

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 
+from .ft import FTConfig, ChaosPlan, guard as ftguard
 from .obs import NULL, Telemetry
 from .ops import sgd
 from .parallel import mesh as meshlib
@@ -98,7 +99,59 @@ def build_parser() -> argparse.ArgumentParser:
                         "tools/telemetry_report.py. Off by default (zero "
                         "overhead); the stdout print schedule is unchanged "
                         "either way")
+    ft = p.add_argument_group(
+        "fault tolerance (ft/)",
+        "preemption-safe resume, supervised staging, non-finite guard and "
+        "the deterministic chaos harness; all off by default (the hot path "
+        "pays nothing)")
+    ft.add_argument("--nonfinite", default="off", choices=ftguard.POLICIES,
+                    help="per-step finiteness guard on loss + global grad "
+                         "norm: halt = raise (the bad update is never "
+                         "applied), skip = keep prior params and continue, "
+                         "restore = roll back to the last checkpoint "
+                         "snapshot; off (default) compiles no guard at all")
+    ft.add_argument("--chaos", action="append", default=None,
+                    metavar="SITE:step[:seed]",
+                    help="inject a deterministic fault once at the given "
+                         "step (repeatable); sites: producer_crash, "
+                         "put_delay, put_fail, corrupt_slot, nonfinite_grad "
+                         "(requires --nonfinite != off), preempt (requires "
+                         "--checkpoint-dir)")
+    ft.add_argument("--ft-put-timeout", type=float, default=30.0,
+                    metavar="SECONDS",
+                    help="watchdog deadline on each staged chunk device_put")
+    ft.add_argument("--ft-put-retries", type=int, default=3,
+                    help="attempts per chunk device_put (exponential "
+                         "backoff between attempts)")
+    ft.add_argument("--ft-stall-timeout", type=float, default=120.0,
+                    metavar="SECONDS",
+                    help="consumer-side staging stall deadline; exceeding "
+                         "it triggers producer restart, then degraded "
+                         "synchronous staging (stream bit-identical)")
+    ft.add_argument("--ft-verify-chunks", action="store_true",
+                    help="checksum every staged batch at fill time and "
+                         "re-stage any row whose bytes changed by transfer "
+                         "time (auto-enabled by corrupt_slot chaos)")
     return p
+
+
+def ft_config_from_args(args) -> "FTConfig | None":
+    """FTConfig when any ft surface is requested, else None (the Trainer's
+    ft=None fast path — no supervision wrappers, no guard compiled)."""
+    defaults = (args.nonfinite == "off" and not args.chaos
+                and args.ft_put_timeout == 30.0 and args.ft_put_retries == 3
+                and args.ft_stall_timeout == 120.0
+                and not args.ft_verify_chunks)
+    if defaults:
+        return None
+    return FTConfig(
+        nonfinite=args.nonfinite,
+        chaos=ChaosPlan.parse(args.chaos),
+        put_timeout_s=args.ft_put_timeout,
+        put_retries=args.ft_put_retries,
+        stall_timeout_s=args.ft_stall_timeout,
+        verify_chunks=args.ft_verify_chunks,
+    )
 
 
 def main(argv=None) -> None:
@@ -130,6 +183,7 @@ def main(argv=None) -> None:
         limit_train_batches=args.limit_train_batches,
         limit_eval_batches=args.limit_eval_batches,
         telemetry=telemetry,
+        ft=ft_config_from_args(args),
     )
     try:
         trainer.run(args.epochs, checkpoint_dir=args.checkpoint_dir,

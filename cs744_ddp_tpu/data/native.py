@@ -294,14 +294,27 @@ class StagingArena:
         tests); training code goes through ``acquire``."""
         return self._bufs[slot]
 
-    def acquire(self):
+    def acquire(self, *, fence_timeout_s=None, on_timeout=None):
         """-> (slot_id, buffer): the next writable slot, after fencing any
-        in-flight transfer that still reads this slot's memory."""
+        in-flight transfer that still reads this slot's memory.
+
+        ``fence_timeout_s``/``on_timeout`` (ft supervision, train/loop.py)
+        arm a DETECTION-ONLY watchdog around the fence wait:
+        ``block_until_ready`` is a native call that cannot be interrupted
+        from Python, so a wedged transfer can only be reported (the
+        callback fires, telemetry counts it) — the consumer-side stall
+        deadline in the prefetch loop is what converts the report into
+        recovery."""
         i = self._next
         self._next = (i + 1) % len(self._bufs)
         dep = self._pending[i]
         if dep is not None:
-            dep.block_until_ready()
+            if fence_timeout_s is not None:
+                from ..ft.supervisor import Watchdog
+                with Watchdog(fence_timeout_s, on_timeout=on_timeout):
+                    dep.block_until_ready()
+            else:
+                dep.block_until_ready()
             self._pending[i] = None
         return i, self._bufs[i]
 

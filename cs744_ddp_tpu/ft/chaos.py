@@ -1,0 +1,156 @@
+"""Deterministic fault injection: the seeded chaos plan.
+
+A chaos plan is a list of ``(site, step, seed)`` entries — parsed from CLI
+specs ``SITE:step[:seed]`` or built programmatically — that fire EXACTLY
+ONCE when training reaches the named step.  Determinism is the point: a
+chaos run is reproducible (same plan, same seed, same faults at the same
+steps), so the recovery path's output can be pinned against a fault-free
+run in CI, which is what turns "we have retry code" into "the retry code
+provably preserves the training stream".
+
+Injection sites (each names a real failure mode of the training stack):
+
+* ``producer_crash``   — the host-augment staging producer thread dies
+                         (uncaught exception) while filling batch ``step``;
+* ``put_delay``        — the chunk ``device_put`` covering ``step`` stalls
+                         (sleeps past the watchdog timeout) once;
+* ``put_fail``         — that put raises once (transient transfer error);
+* ``corrupt_slot``     — the staged arena bytes for batch ``step`` are
+                         corrupted (seeded XOR) after checksumming — the
+                         signature of a buffer-reuse/aliasing bug;
+* ``nonfinite_grad``   — the compiled step's gradients go NaN at batch
+                         ``step`` (overflow/instability stand-in);
+* ``preempt``          — SIGTERM is delivered to this process at the first
+                         step boundary >= ``step`` (pod preemption).
+
+The disabled plan is ``NULL_CHAOS`` — a stateless singleton exactly like
+the telemetry ``NULL`` recorder: ``enabled`` is False, ``fire*`` return
+False without allocating, and hot call sites guard on ``.enabled`` so the
+no-chaos path costs nothing (pinned by tests/test_ft.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+SITES = ("producer_crash", "put_delay", "put_fail", "corrupt_slot",
+         "nonfinite_grad", "preempt")
+
+
+class ChaosError(RuntimeError):
+    """An injected fault (never raised by real failures — recovery paths
+    that catch broadly still distinguish injected faults in telemetry)."""
+
+
+class NullChaos:
+    """The disabled plan: every query is False, no state can ever attach."""
+    __slots__ = ()
+    enabled = False
+
+    def fire(self, site: str, step: int) -> bool:
+        return False
+
+    def fire_range(self, site: str, lo: int, hi: int) -> bool:
+        return False
+
+    def fire_reached(self, site: str, step: int) -> bool:
+        return False
+
+    def steps(self, site: str) -> Tuple[int, ...]:
+        return ()
+
+    def spec(self):
+        return []
+
+
+NULL_CHAOS = NullChaos()
+
+
+class ChaosPlan:
+    """A list of one-shot injections, thread-safe (the staging producer
+    thread fires sites too).  ``fired`` records what actually fired, in
+    order — the test/telemetry surface."""
+
+    enabled = True
+
+    def __init__(self, entries: Sequence[Tuple[str, int, int]]):
+        for site, step, _seed in entries:
+            if site not in SITES:
+                raise ValueError(f"unknown chaos site {site!r}; "
+                                 f"expected one of {SITES}")
+            if step < 0:
+                raise ValueError(f"chaos step must be >= 0, got {step}")
+        self._entries: List[dict] = [
+            {"site": s, "step": st, "seed": sd, "fired": False}
+            for s, st, sd in entries]
+        self._lock = threading.Lock()
+        self.fired: List[Tuple[str, int]] = []
+
+    @classmethod
+    def parse(cls, specs: Optional[Sequence[str]]):
+        """Parse CLI specs ``SITE:step[:seed]`` -> plan (or ``NULL_CHAOS``
+        for an empty list, so the disabled path stays the stateless
+        singleton)."""
+        if not specs:
+            return NULL_CHAOS
+        entries = []
+        for spec in specs:
+            parts = spec.split(":")
+            if len(parts) not in (2, 3):
+                raise ValueError(
+                    f"bad chaos spec {spec!r}: expected SITE:step[:seed]")
+            site = parts[0]
+            try:
+                step = int(parts[1])
+                seed = int(parts[2]) if len(parts) == 3 else 0
+            except ValueError:
+                raise ValueError(f"bad chaos spec {spec!r}: step/seed must "
+                                 f"be integers") from None
+            entries.append((site, step, seed))
+        return cls(entries)
+
+    def _fire(self, site: str, match) -> Optional[dict]:
+        with self._lock:
+            for e in self._entries:
+                if e["site"] == site and not e["fired"] and match(e["step"]):
+                    e["fired"] = True
+                    self.fired.append((site, e["step"]))
+                    return e
+        return None
+
+    def fire(self, site: str, step: int) -> bool:
+        """One-shot: True exactly once per entry whose step == ``step``."""
+        return self._fire(site, lambda s: s == step) is not None
+
+    def fire_range(self, site: str, lo: int, hi: int) -> bool:
+        """One-shot over a half-open step range [lo, hi) — chunk-level
+        sites cover several batches per operation."""
+        return self._fire(site, lambda s: lo <= s < hi) is not None
+
+    def fire_reached(self, site: str, step: int) -> bool:
+        """One-shot when progress ``step`` reaches/passes the entry —
+        boundary-polled sites (preemption is checked between dispatch
+        windows, not at every batch)."""
+        return self._fire(site, lambda s: step >= s) is not None
+
+    def steps(self, site: str) -> Tuple[int, ...]:
+        """All step indices planned for ``site`` (fired or not) — what the
+        compiled-in injection closures are built from."""
+        return tuple(e["step"] for e in self._entries if e["site"] == site)
+
+    def spec(self):
+        """Manifest-shaped view of the plan (site/step/seed per entry)."""
+        return [{"site": e["site"], "step": e["step"], "seed": e["seed"]}
+                for e in self._entries]
+
+    def rng(self, site: str, step: int):
+        """Seeded generator for an entry's fault payload (corruption byte
+        positions/values) — deterministic in (seed, site, step)."""
+        import numpy as np
+        seed = 0
+        for e in self._entries:
+            if e["site"] == site and e["step"] == step:
+                seed = e["seed"]
+                break
+        return np.random.default_rng([seed, SITES.index(site), step])

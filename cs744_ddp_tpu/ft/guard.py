@@ -1,0 +1,64 @@
+"""Non-finite step guard: on-device finiteness check + conditional update.
+
+A NaN/Inf loss or gradient poisons every subsequent step — by the time a
+host-side print shows ``loss: nan`` the params are already garbage.  The
+guard checks ``isfinite(loss) & isfinite(sum_g ||g||^2)`` *inside* the
+compiled step (one scalar reduction over gradient leaves — noise next to
+the backward pass) and selects the update with ``jnp.where``:
+
+* ok     -> the normal SGD update (params, BN stats, momentum all advance);
+* not ok -> every component keeps its PRIOR value — params unchanged, BN
+            statistics unchanged, momentum unchanged, exactly as if the
+            batch had not been seen.
+
+The select is branch-free so the program stays a single trace (windowed
+``lax.scan`` included).  Policy semantics live host-side in the Trainer:
+``halt`` raises, ``skip`` counts and continues, ``restore`` additionally
+rolls params back to the last checkpoint snapshot.  When the policy is
+``off`` none of this is compiled in — the step program is byte-identical
+to the unguarded one.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class NonFiniteError(RuntimeError):
+    """Raised under ``--nonfinite=halt`` when a non-finite step is caught
+    (state has NOT absorbed the bad update — the on-device select already
+    kept the prior params)."""
+
+
+POLICIES = ("off", "halt", "skip", "restore")
+
+
+def grad_sqnorm(grads):
+    """Global squared gradient norm as one f32 scalar (NaN/Inf anywhere in
+    any leaf propagates into it, which is all the guard needs)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    return sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+
+
+def finite_ok(loss, grads):
+    """Scalar bool: this step's loss and every gradient entry are finite."""
+    return jnp.isfinite(loss) & jnp.isfinite(grad_sqnorm(grads))
+
+
+def select_update(ok, new_tree, old_tree):
+    """Branch-free per-leaf select: ``new`` where ok else ``old``."""
+    return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new_tree, old_tree)
+
+
+def inject_nan(grads, mask=None):
+    """Chaos helper: poison gradients with NaN.  ``mask`` (scalar bool or
+    None for unconditional) keeps the injection traceable inside a scan —
+    the window program folds ``mask = (abs_idx == chaos_step)`` so a single
+    compiled program injects at exactly one batch of the epoch."""
+    def poison(g):
+        bad = jnp.asarray(jnp.nan, g.dtype)
+        if mask is None:
+            return g + bad
+        return g + jnp.where(mask, bad, jnp.zeros((), g.dtype))
+    return jax.tree.map(poison, grads)

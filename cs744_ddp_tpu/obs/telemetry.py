@@ -38,6 +38,48 @@ from typing import Any, Dict, IO, List, Optional, Tuple
 _SCHEMA_VERSION = 1
 
 
+def atomic_write_json(path: str, obj, indent: Optional[int] = 2) -> None:
+    """Complete-or-absent JSON write: dump to a unique temp file in the
+    same directory, then ``os.replace`` into place.  A crash or preemption
+    signal mid-write leaves either the previous file or the new one —
+    never a torn half-document (pinned by tests/test_ft.py with a
+    kill-mid-write subprocess)."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=indent, default=str)
+            f.write("\n")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def read_events_jsonl(path: str,
+                      warn=None) -> Tuple[List[Dict[str, Any]], int]:
+    """Read an events.jsonl -> (events, n_bad).  A run killed mid-write
+    (preemption is a NORMAL exit path for this codebase) legitimately
+    leaves a truncated final line; undecodable lines are counted and
+    reported through ``warn`` (callable, e.g. ``log``) instead of failing
+    the whole report."""
+    events: List[Dict[str, Any]] = []
+    n_bad = 0
+    if not os.path.exists(path):
+        return events, n_bad
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                n_bad += 1
+                if warn is not None:
+                    warn(f"{path}:{lineno}: undecodable event line "
+                         f"(truncated write?) — skipped")
+    return events, n_bad
+
+
 def percentile(values: List[float], q: float) -> float:
     """Linear-interpolation percentile of an UNSORTED sample, q in [0, 100].
 
@@ -227,10 +269,8 @@ class Telemetry:
         man.update(fields)
         self.manifest = man
         if self.out_dir is not None:
-            path = os.path.join(self.out_dir, "manifest.json")
-            with open(path, "w") as f:
-                json.dump(man, f, indent=2, default=str)
-                f.write("\n")
+            atomic_write_json(os.path.join(self.out_dir, "manifest.json"),
+                              man)
 
     def finalize(self, **extra) -> Dict[str, Any]:
         """Compute the steady-state summary; write ``summary.json`` if the
@@ -240,9 +280,8 @@ class Telemetry:
         summary = summarize_events(events, **extra)
         self.summary = summary
         if self.out_dir is not None:
-            with open(os.path.join(self.out_dir, "summary.json"), "w") as f:
-                json.dump(summary, f, indent=2, default=str)
-                f.write("\n")
+            atomic_write_json(os.path.join(self.out_dir, "summary.json"),
+                              summary)
             with self._lock:
                 if self._fh is not None:
                     self._fh.close()
@@ -255,11 +294,9 @@ class Telemetry:
         with self._lock:
             if self._fh is not None:
                 self._fh.flush()
-        path = os.path.join(self.out_dir, "events.jsonl")
-        if not os.path.exists(path):
-            return []
-        with open(path) as f:
-            return [json.loads(line) for line in f if line.strip()]
+        events, _ = read_events_jsonl(
+            os.path.join(self.out_dir, "events.jsonl"))
+        return events
 
 
 def summarize_events(events: List[Dict[str, Any]],
@@ -324,9 +361,5 @@ def read_run(out_dir: str) -> Tuple[Optional[Dict[str, Any]],
 
     manifest = _load("manifest.json")
     summary = _load("summary.json")
-    events: List[Dict[str, Any]] = []
-    path = os.path.join(out_dir, "events.jsonl")
-    if os.path.exists(path):
-        with open(path) as f:
-            events = [json.loads(line) for line in f if line.strip()]
+    events, _ = read_events_jsonl(os.path.join(out_dir, "events.jsonl"))
     return manifest, events, summary

@@ -37,6 +37,24 @@ STATE_FORMAT_VERSION = 2
 # the stamp's introduction) — what a missing stamp migrates to.
 _UNSTAMPED_DIR_VERSION = 2
 
+# Mid-epoch (emergency) checkpoints are keyed by one orbax step integer
+# encoding (epoch, step-within-epoch); an epoch never holds this many
+# batches, so the encoding is collision-free and order-preserving.
+_MID_KEY_BASE = 10 ** 6
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    """Complete-or-absent JSON write (tmp + rename); a preemption signal
+    arriving mid-write must never leave a torn metadata file."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
 
 class CheckpointManager:
     """Thin orbax CheckpointManager wrapper keyed on completed epochs.
@@ -51,6 +69,8 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3,
                  config: Optional[dict] = None):
         directory = os.path.abspath(directory)
+        self._dir = directory
+        self._mid = None  # lazy orbax manager for mid-epoch checkpoints
         self._config_path = os.path.join(directory, "trainer_config.json")
         if config is not None:
             config = {**config,
@@ -156,5 +176,88 @@ class CheckpointManager:
             epoch, args=ocp.args.StandardRestore(abstract))
         return TrainState(*restored), epoch + 1
 
+    # ------------------------------------------------------------------
+    # Mid-epoch (emergency) checkpoints — the preemption path (ft/).
+    #
+    # A separate orbax manager under <dir>/mid_epoch keyed by the encoded
+    # (epoch, step) holds AT MOST ONE checkpoint: the state after ``step``
+    # batches of ``epoch``.  The data-order state needed to resume is fully
+    # derivable from (seed, epoch, step) — the sampler is a fixed
+    # permutation of (seed, epoch) and every PRNG fold uses the ABSOLUTE
+    # batch index — so the sidecar meta records those plus the sampler
+    # config for auditability, and restore needs only the step key.
+    # ------------------------------------------------------------------
+
+    def _mid_dir(self) -> str:
+        return os.path.join(self._dir, "mid_epoch")
+
+    def _mid_meta_path(self) -> str:
+        return os.path.join(self._dir, "mid_epoch_meta.json")
+
+    def _mid_mngr(self):
+        if self._mid is None:
+            self._mid = ocp.CheckpointManager(
+                self._mid_dir(),
+                options=ocp.CheckpointManagerOptions(max_to_keep=1,
+                                                     create=True))
+        return self._mid
+
+    def save_mid_epoch(self, epoch: int, step: int, state: TrainState,
+                       data_order: Optional[dict] = None) -> None:
+        """Emergency step-level checkpoint: state after ``step`` batches of
+        ``epoch``; blocks until durable (the caller is about to exit)."""
+        if step >= _MID_KEY_BASE:
+            raise ValueError(f"step {step} exceeds mid-epoch key space")
+        m = self._mid_mngr()
+        m.save(epoch * _MID_KEY_BASE + step,
+               args=ocp.args.StandardSave(state))
+        m.wait_until_finished()
+        meta = {"epoch": epoch, "step": step}
+        if data_order:
+            meta["data_order"] = data_order
+        _atomic_write_json(self._mid_meta_path(), meta)
+
+    def latest_mid_epoch(self) -> Optional[Tuple[int, int]]:
+        """(epoch, step) of the emergency checkpoint, or None.  The orbax
+        step listing is the source of truth (the meta sidecar can lag by a
+        crash between save and meta write)."""
+        if not os.path.isdir(self._mid_dir()):
+            return None
+        key = self._mid_mngr().latest_step()
+        if key is None:
+            return None
+        return divmod(key, _MID_KEY_BASE)
+
+    def restore_mid_epoch(
+            self, state_like: TrainState) -> Tuple[TrainState, int, int]:
+        """(state, epoch, step): resume ``epoch`` from batch ``step``."""
+        at = self.latest_mid_epoch()
+        if at is None:
+            raise FileNotFoundError("no mid-epoch checkpoint to restore")
+        epoch, step = at
+        abstract = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=a.sharding),
+            state_like)
+        restored = self._mid_mngr().restore(
+            epoch * _MID_KEY_BASE + step,
+            args=ocp.args.StandardRestore(abstract))
+        return TrainState(*restored), epoch, step
+
+    def clear_mid_epoch(self) -> None:
+        """Drop the emergency checkpoint (stale once its epoch completes)."""
+        if os.path.exists(self._mid_meta_path()):
+            os.unlink(self._mid_meta_path())
+        if not os.path.isdir(self._mid_dir()):
+            return
+        m = self._mid_mngr()
+        for key in list(m.all_steps()):
+            try:
+                m.delete(key)
+            except (NotImplementedError, OSError):  # pragma: no cover
+                break
+
     def close(self) -> None:
+        if self._mid is not None:
+            self._mid.close()
         self._mngr.close()

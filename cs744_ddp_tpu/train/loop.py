@@ -22,8 +22,9 @@ Differences from the reference, by design (all documented in BASELINE.md):
 
 from __future__ import annotations
 
-import itertools
+import os
 import queue
+import signal
 import threading
 import time
 from typing import Callable, Iterator, Optional, Tuple
@@ -34,6 +35,10 @@ import numpy as np
 
 from .. import models as model_zoo
 from ..data import cifar10, native, sharding
+from ..ft import (FTConfig, ChaosError, NULL_CHAOS, NonFiniteError,
+                  PreemptedError, PreemptionGuard)
+from ..ft import guard as ftguard
+from ..ft import supervisor as ftsup
 from ..obs import NULL, git_sha
 from ..ops import sgd
 from ..parallel import get_strategy, mesh as meshlib
@@ -117,7 +122,8 @@ class Trainer:
                  limit_train_batches: Optional[int] = None,
                  limit_eval_batches: Optional[int] = None,
                  log: Callable[[str], None] = print,
-                 telemetry=NULL):
+                 telemetry=NULL,
+                 ft: Optional[FTConfig] = None):
         self.mesh = mesh if mesh is not None else meshlib.make_mesh(num_devices)
         self.world = self.mesh.devices.size
         if global_batch % self.world:
@@ -176,6 +182,39 @@ class Trainer:
         self.limit_train_batches = limit_train_batches
         self.limit_eval_batches = limit_eval_batches
 
+        # Fault tolerance (ft/): all opt-in through one config.  ft=None —
+        # the default — keeps every hot path byte-identical to the
+        # unsupervised build: chaos is the stateless NULL_CHAOS singleton,
+        # the non-finite guard is never compiled into the step programs,
+        # and the staging pipeline runs exactly the PR-2 code.
+        self.ft = ft
+        self.chaos = ft.chaos if ft is not None else NULL_CHAOS
+        self._nf_policy = ft.nonfinite if ft is not None else "off"
+        if self._nf_policy not in ftguard.POLICIES:
+            raise ValueError(f"nonfinite policy must be one of "
+                             f"{ftguard.POLICIES}, got {self._nf_policy!r}")
+        self._guard_on = self._nf_policy != "off"
+        self._nf_chaos_steps = (self.chaos.steps("nonfinite_grad")
+                                if self.chaos.enabled else ())
+        if self._nf_chaos_steps and not self._guard_on:
+            raise ValueError(
+                "chaos nonfinite_grad injection requires a nonfinite policy "
+                "(halt/skip/restore) — injecting NaNs with the guard off "
+                "just corrupts the run")
+        self._supervise = ft is not None
+        self._verify_chunks = bool(ft is not None and (
+            ft.verify_chunks or self.chaos.steps("corrupt_slot")))
+        self.staging_degraded = bool(ft is not None and ft.degrade_staging)
+        self.preempted = False
+        self._preempt_guard: Optional[PreemptionGuard] = None
+        self._rollback = None            # host snapshot for policy=restore
+        self._chaos_step_cache: dict = {}
+        self.nonfinite_skipped = 0       # run totals (epoch counts are
+        self.nonfinite_restored = 0      # logged per epoch summary)
+        self._epoch_nf_skipped = 0
+        self._epoch_nf_restored = 0
+        self.producer_failures = 0
+
         # Split-replacement generations: staging caches key on these, so
         # swapping a split always restages (id() reuse after GC cannot serve
         # stale device arrays).  Must exist before the property assignments.
@@ -222,14 +261,15 @@ class Trainer:
         strat = get_strategy(strategy)
         self.train_step = steplib.make_train_step(
             self.apply_fn, strat, self.mesh, sgd_cfg, augment=augment,
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, nonfinite_guard=self._guard_on)
         self.train_window = steplib.make_train_window(
             self.apply_fn, strat, self.mesh, sgd_cfg, augment=augment,
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, nonfinite_guard=self._guard_on,
+            nonfinite_chaos_steps=self._nf_chaos_steps)
         if host_augment:
             self.train_step_host = steplib.make_train_step(
                 self.apply_fn, strat, self.mesh, sgd_cfg, augment="host",
-                compute_dtype=compute_dtype)
+                compute_dtype=compute_dtype, nonfinite_guard=self._guard_on)
             # The windowed host path ships COMPACT uint8 (the C++ pipeline
             # does the stochastic crop/flip; the affine normalize fuses
             # into the device step, augment=False = normalize-only): the
@@ -237,7 +277,8 @@ class Trainer:
             # uint8 carries 4x fewer bytes than the f32 per-step format.
             self.train_window_host = steplib.make_train_window(
                 self.apply_fn, strat, self.mesh, sgd_cfg, augment=False,
-                compute_dtype=compute_dtype)
+                compute_dtype=compute_dtype, nonfinite_guard=self._guard_on,
+                nonfinite_chaos_steps=self._nf_chaos_steps)
         self.eval_window = steplib.make_eval_window(
             self.apply_fn, self.mesh, compute_dtype=compute_dtype)
         if profile_phases:
@@ -276,9 +317,26 @@ class Trainer:
         self.last_epoch_timers: Optional[WindowedTimers] = None
         self._collective_stats_emitted = False
 
+        if self._nf_policy == "restore":
+            # "Last checkpoint" before any save is the initial state.
+            self._snapshot_rollback()
+
         if telemetry.enabled:
             d0 = self.mesh.devices.flat[0]
+            ft_manifest = None
+            if ft is not None:
+                ft_manifest = {
+                    "nonfinite": self._nf_policy,
+                    "chaos": self.chaos.spec() if self.chaos.enabled else [],
+                    "put_timeout_s": ft.put_timeout_s,
+                    "put_retries": ft.put_retries,
+                    "stall_timeout_s": ft.stall_timeout_s,
+                    "producer_restarts": ft.producer_restarts,
+                    "verify_chunks": self._verify_chunks,
+                    "degrade_staging": ft.degrade_staging,
+                }
             telemetry.write_manifest({
+                "fault_tolerance": ft_manifest,
                 "model": self.model_name,
                 "strategy": self.strategy_name,
                 "world_size": self.world,
@@ -361,6 +419,99 @@ class Trainer:
                 "total_count": stats["total_count"],
                 "total_result_mib": stats["total_result_mib"],
                 "chain_depth": hlo_stats.collective_chain_depth(txt)})
+
+    # -- fault tolerance (ft/) ----------------------------------------------
+
+    def _snapshot_rollback(self) -> None:
+        """Host copy of the current state — the ``--nonfinite=restore``
+        rollback target, refreshed after every checkpoint save.  A HOST
+        copy: the windowed programs donate their state buffers, so a kept
+        device reference would be invalidated by the next dispatch."""
+        self._rollback = jax.tree.map(
+            lambda a: np.asarray(jax.device_get(a)), self.state)
+
+    def _restore_rollback(self) -> None:
+        self.state = meshlib.put_global_tree(
+            jax.tree.map(jnp.asarray, self._rollback),
+            meshlib.replicated(self.mesh))
+
+    def _handle_nonfinite(self, oks, epoch: int) -> bool:
+        """Host-side reaction to the fetched per-step ``ok`` flags.  The
+        on-device select already kept the prior state for every bad step —
+        this layer only counts and applies the policy.  Returns True when
+        the state was rolled back (policy=restore)."""
+        oks = np.asarray(oks)
+        bad = int(oks.size - np.count_nonzero(oks))
+        if bad == 0:
+            return False
+        if self._nf_policy == "halt":
+            raise NonFiniteError(
+                f"non-finite loss/grad-norm in epoch {epoch} "
+                f"(policy=halt; the bad update was NOT applied)")
+        if self._nf_policy == "skip":
+            self._epoch_nf_skipped += bad
+            self.nonfinite_skipped += bad
+            self.telemetry.counter("nonfinite_skipped", bad, epoch=epoch)
+            return False
+        # restore: the select already skipped the bad update; additionally
+        # rewind to the last checkpoint snapshot — steps since it are lost
+        # (training continues with the NEXT batch, not a replay).
+        self._epoch_nf_restored += bad
+        self.nonfinite_restored += bad
+        self.telemetry.counter("nonfinite_restored", bad, epoch=epoch)
+        self._restore_rollback()
+        self.log(f"Non-finite step: state rolled back to the last "
+                 f"checkpoint snapshot (epoch {epoch})")
+        return True
+
+    def _fetch_step(self, out):
+        """Advance ``self.state`` from a per-step program result, absorbing
+        the guarded arity; returns (loss, ok_or_None) as host values (the
+        loss fetch is the completion fence either way)."""
+        if self._guard_on:
+            self.state, loss, ok = out
+            return float(loss), bool(ok)
+        self.state, loss = out
+        return float(loss), None
+
+    def _chaos_nf_step(self, host: bool):
+        """The per-step chaos variant: same program as train_step(_host)
+        plus an unconditional NaN injection into the gradients.  Built
+        lazily (one extra compile only on chaos runs) and swapped in for
+        exactly the planned batch by the per-step paths — the windowed
+        paths instead bake the absolute-index mask into their one program
+        (make_train_window nonfinite_chaos_steps)."""
+        cache_key = "host" if host else "dev"
+        fn = self._chaos_step_cache.get(cache_key)
+        if fn is None:
+            fn = steplib.make_train_step(
+                self.apply_fn, get_strategy(self.strategy_name), self.mesh,
+                self.sgd_cfg, augment="host" if host else self.augment,
+                compute_dtype=self.compute_dtype, nonfinite_guard=True,
+                inject_nonfinite=True)
+            self._chaos_step_cache[cache_key] = fn
+        return fn
+
+    def _record_chaos(self, site: str, step: int) -> None:
+        self.telemetry.counter("chaos_injected", 1, site=site, step=step)
+        self.log(f"chaos: injected {site} at step {step}")
+
+    def _check_preempt(self, epoch: int, step: int) -> None:
+        """Step-boundary preemption poll: fire any planned chaos SIGTERM
+        once progress reaches its step, then raise ``PreemptedError`` if a
+        signal has arrived (real or injected).  ``step`` is the number of
+        batches already trained this epoch — exactly the resume point."""
+        if self.chaos.enabled and self.chaos.fire_reached("preempt", step):
+            if self._preempt_guard is None:
+                raise RuntimeError(
+                    "chaos preempt requires run(checkpoint_dir=...) — "
+                    "without the guard installed SIGTERM would kill the "
+                    "process uncheckpointed")
+            self._record_chaos("preempt", step)
+            os.kill(os.getpid(), signal.SIGTERM)
+        g = self._preempt_guard
+        if g is not None and g.requested:
+            raise PreemptedError(epoch, step)
 
     # -- dataset splits (generation-tracked for staging-cache keys) ---------
 
@@ -518,7 +669,7 @@ class Trainer:
 
     # -- reference-parity loops --------------------------------------------
 
-    def train_model(self, epoch: int) -> WindowedTimers:
+    def train_model(self, epoch: int, start_step: int = 0) -> WindowedTimers:
         """One training epoch with the reference's print/timing schedule.
 
         Default mode runs one compiled dispatch per 20-iteration window
@@ -526,11 +677,28 @@ class Trainer:
         granularity the reference reports at.  ``profile_phases=True``
         switches to the per-step path, which additionally times a
         forward-only program to report the reference's fwd/bwd split.
+
+        ``start_step`` (mid-epoch resume, ft/) skips the first N batches:
+        every PRNG fold uses the ABSOLUTE batch index and the sampler is a
+        fixed permutation of (seed, epoch), so training [start_step..n)
+        after restoring the step checkpoint is bitwise-identical to the
+        uninterrupted run's tail (pinned by tests/test_ft.py).
         """
+        self._epoch_nf_skipped = 0
+        self._epoch_nf_restored = 0
+        timers = self._train_model_impl(epoch, start_step)
+        if self._guard_on and (self._epoch_nf_skipped
+                               or self._epoch_nf_restored):
+            self.log(f"Non-finite guard (epoch {epoch}): "
+                     f"{self._epoch_nf_skipped} update(s) skipped, "
+                     f"{self._epoch_nf_restored} rollback(s)")
+        return timers
+
+    def _train_model_impl(self, epoch: int, start_step: int) -> WindowedTimers:
         if self.profile_phases:
-            return self._train_model_per_step(epoch)
+            return self._train_model_per_step(epoch, start_step)
         if self.host_augment:
-            return self._train_model_host_windowed(epoch)
+            return self._train_model_host_windowed(epoch, start_step)
         if self.telemetry.enabled:
             self._emit_collective_telemetry()
         timers = WindowedTimers(self.log, telemetry=self.telemetry,
@@ -540,34 +708,54 @@ class Trainer:
         self._warm_train_windows(staged)
         epoch_images, epoch_labels, tail = staged
         nbatches = epoch_images.shape[0]
-        start = 0
+        start = start_step
+        self._check_preempt(epoch, start)
         while start < nbatches:
-            w = min(WINDOW, nbatches - start)
+            # Resume windows re-align to the ABSOLUTE window grid: the
+            # emergency checkpoint always lands on a boundary, so the
+            # resumed run re-dispatches the exact window shapes the
+            # uninterrupted run would — the bitwise-resume invariant does
+            # not depend on scan-length-invariance of the compiler.
+            w = min(WINDOW - start % WINDOW, nbatches - start)
             t0 = time.time()
-            self.state, losses = self.train_window(
+            out = self.train_window(
                 self.state, key, epoch_images, epoch_labels,
                 jnp.int32(start), jnp.zeros((w,), jnp.int8))
+            if self._guard_on:
+                self.state, losses, oks = out
+            else:
+                (self.state, losses), oks = out, None
             losses = np.asarray(losses)  # value fetch = completion fence
             per_iter = (time.time() - t0) / w
             for loss in losses:
                 timers.record(float(loss), per_iter)
+            if self._nf_chaos_steps and \
+                    self.chaos.fire_range("nonfinite_grad", start, start + w):
+                self._record_chaos("nonfinite_grad", next(
+                    s for s in self._nf_chaos_steps if start <= s < start + w))
             start += w
-        if tail is not None:
+            if oks is not None:
+                self._handle_nonfinite(oks, epoch)
+            self._check_preempt(epoch, start)
+        if tail is not None and start_step <= nbatches:
             # The ragged final batch (drop_last=False parity) through its
             # own compiled step; host-side fold of the batch index keeps the
             # canonical (index, position) key order of both other paths.
             self._warm_tail_step(tail)  # keep the compile out of the timer
             tail_key = jax.random.fold_in(key, nbatches)
             t0 = time.time()
-            self.state, loss = self.train_step(self.state, tail_key, *tail)
-            loss = float(loss)  # value fetch = completion fence
+            loss, ok = self._fetch_step(
+                self.train_step(self.state, tail_key, *tail))
             # steady=False: this lone per-dispatch sample carries the fixed
             # dispatch latency the amortized window samples do not.
             timers.record(loss, time.time() - t0, steady=False)
+            if ok is not None:
+                self._handle_nonfinite(np.asarray([ok]), epoch)
         self.last_epoch_timers = timers
         return timers
 
-    def _train_model_per_step(self, epoch: int) -> WindowedTimers:
+    def _train_model_per_step(self, epoch: int,
+                              start_step: int = 0) -> WindowedTimers:
         """Per-batch dispatch path: the fwd/bwd phase split
         (``profile_phases``) and/or the host-side augmentation pipeline
         (``host_augment`` — per-batch host work is the point of that mode,
@@ -583,15 +771,21 @@ class Trainer:
             else self.train_step
         self._warm_per_step_tail_shapes()
         if self.host_augment:
-            batches = self._iter_host_batches(epoch)
+            batches = self._iter_host_batches(epoch, start_it=start_step)
         else:
-            batches = ((it, *self._put(imgs, labs))
-                       for it, (imgs, labs) in enumerate(_shard_batches(
-                           self.train_split, self.world, self.global_batch,
-                           epoch, shuffle=True, seed=self.seed,
-                           reshuffle_each_epoch=self.reshuffle_each_epoch)))
-            if self.limit_train_batches is not None:
-                batches = itertools.islice(batches, self.limit_train_batches)
+            def device_batches():
+                for it, (imgs, labs) in enumerate(_shard_batches(
+                        self.train_split, self.world, self.global_batch,
+                        epoch, shuffle=True, seed=self.seed,
+                        reshuffle_each_epoch=self.reshuffle_each_epoch)):
+                    if self.limit_train_batches is not None and \
+                            it >= self.limit_train_batches:
+                        break
+                    if it < start_step:
+                        continue
+                    yield (it, *self._put(imgs, labs))
+            batches = device_batches()
+        self._check_preempt(epoch, start_step)
         for it, x, y in batches:
             step_key = jax.random.fold_in(key, it)
             fwd_time = None
@@ -603,15 +797,23 @@ class Trainer:
                 np.asarray(self._fwd_only(
                     self.state.params, self.state.bn_state, x, y))
                 fwd_time = time.time() - t0
+            fn = step_fn
+            if self._nf_chaos_steps and it in self._nf_chaos_steps and \
+                    self.chaos.fire("nonfinite_grad", it):
+                # Swap in the NaN-injecting variant for exactly this batch.
+                self._record_chaos("nonfinite_grad", it)
+                fn = self._chaos_nf_step(bool(self.host_augment))
             t0 = time.time()
-            self.state, loss = step_fn(self.state, step_key, x, y)
-            loss = float(loss)  # value fetch = completion fence
+            loss, ok = self._fetch_step(fn(self.state, step_key, x, y))
             # The fused step contains its own forward; the separately-timed
             # forward-only program is ONLY used to report the reference's
             # fwd/bwd split (backward ≈ fused − forward) and is excluded
             # from the step time so totals aren't inflated.
             step_time = time.time() - t0
             timers.record(loss, step_time, fwd_time)
+            if ok is not None:
+                self._handle_nonfinite(np.asarray([ok]), epoch)
+            self._check_preempt(epoch, it + 1)
         self.last_epoch_timers = timers
         return timers
 
@@ -662,7 +864,8 @@ class Trainer:
     # DataLoader keeps the same depth of completed batches ahead.
     PREFETCH_DEPTH = 2
 
-    def _prefetch_iter(self, fill, depth: Optional[int] = None):
+    def _prefetch_iter(self, fill, depth: Optional[int] = None,
+                       stall_timeout_s: Optional[float] = None):
         """Producer-thread prefetch scaffolding shared by both host-augment
         paths: runs ``fill(emit)`` on a daemon thread — ``emit(item)``
         enqueues and returns False once the consumer has gone away — and
@@ -672,7 +875,11 @@ class Trainer:
         Every producer exit path enqueues a sentinel (BaseException
         included) so the consumer can never block forever; the consumer
         polls with a timeout and drains the queue before declaring a dead
-        producer sentinel-less."""
+        producer sentinel-less.  ``stall_timeout_s`` (ft supervision) is
+        the consumer-side hard deadline: no item within it while the
+        producer looks alive raises ``StagingStalled`` — the recovery
+        trigger a detection-only watchdog cannot be (it can't interrupt a
+        wedged native call)."""
         q: queue.Queue = queue.Queue(maxsize=depth or self.PREFETCH_DEPTH)
         stop = threading.Event()
 
@@ -698,6 +905,7 @@ class Trainer:
         t = threading.Thread(target=produce, daemon=True,
                              name="host-augment-prefetch")
         t.start()
+        last_item_t = time.time()
         try:
             while True:
                 if self.telemetry.enabled:
@@ -707,8 +915,16 @@ class Trainer:
                     self.telemetry.gauge("prefetch_queue_depth", q.qsize())
                 try:
                     kind, payload = q.get(timeout=1.0)
+                    last_item_t = time.time()
                 except queue.Empty:
                     if t.is_alive():
+                        stalled = time.time() - last_item_t
+                        if stall_timeout_s is not None and \
+                                stalled > stall_timeout_s:
+                            raise ftsup.StagingStalled(
+                                f"no staged item for {stalled:.1f}s "
+                                f"(deadline {stall_timeout_s}s) with the "
+                                f"producer thread alive but stuck")
                         continue
                     # Producer exited; its final put may have raced our
                     # timeout, so drain non-blockingly before declaring it
@@ -732,7 +948,7 @@ class Trainer:
                 self.log("warning: host-augment prefetch thread did not "
                          "exit within 10s")
 
-    def _iter_host_batches(self, epoch: int):
+    def _iter_host_batches(self, epoch: int, start_it: int = 0):
         """Double-buffered host-augment pipeline: yields ``(it, x, y)`` with
         batch k+1 gathered, C++-augmented and device-put on a producer
         thread while step k runs on device — the reference's
@@ -742,7 +958,9 @@ class Trainer:
         The host RNG stream is counter-based in (seed, epoch, it)
         (``_host_transform``), so the prefetched stream is BIT-IDENTICAL
         to the serial one regardless of thread timing — pinned by
-        tests/test_cli_and_profiling.py."""
+        tests/test_cli_and_profiling.py.  ``start_it`` (mid-epoch resume)
+        skips earlier batches; the absolute ``it`` keys the stream, so the
+        suffix is the uninterrupted run's suffix."""
         def fill(emit):
             for it, (imgs, labs) in enumerate(_shard_batches(
                     self.train_split, self.world, self.global_batch,
@@ -751,11 +969,16 @@ class Trainer:
                 if self.limit_train_batches is not None and \
                         it >= self.limit_train_batches:
                     break
+                if it < start_it:
+                    continue
                 if not emit((it, *self._put_host_augmented(
                         imgs, labs, epoch, it))):
                     return
 
-        return self._prefetch_iter(fill)
+        return self._prefetch_iter(
+            fill,
+            stall_timeout_s=self.ft.stall_timeout_s
+            if self._supervise else None)
 
     def _chunk_cap(self) -> int:
         """Batches per staging chunk: WINDOW split into ``host_chunks``
@@ -819,7 +1042,54 @@ class Trainer:
             for s in range(self._staging_arena.nslots))
         return self._staging_arena
 
-    def _iter_host_window_chunks(self, epoch: int):
+    def _on_put_timeout(self, elapsed_s: float) -> None:
+        """Watchdog callback: a chunk device_put exceeded its deadline —
+        detection-only (the put may still complete); counted so a slow link
+        shows up in telemetry before it becomes a stall."""
+        if self.telemetry.enabled:
+            self.telemetry.counter("staging_put_timeout")
+        self.log(f"ft: chunk device_put exceeded its "
+                 f"{self.ft.put_timeout_s}s watchdog deadline "
+                 f"({elapsed_s:.1f}s elapsed)")
+
+    def _on_put_retry(self, attempt: int, exc: BaseException) -> None:
+        if self.telemetry.enabled:
+            self.telemetry.counter("staging_put_retry")
+        self.log(f"ft: chunk device_put attempt {attempt + 1} failed "
+                 f"({exc!r}); retrying with backoff")
+
+    def _supervised_put(self, src, lo: int, hi: int):
+        """A chunk ``put_global`` under ft supervision: chaos injection
+        (``put_fail`` raises once, ``put_delay`` sleeps past the watchdog
+        once — both keyed to the chunk's ABSOLUTE batch range [lo, hi)),
+        a detection-only watchdog on the put itself, and bounded
+        exponential-backoff retry.  Without an FTConfig this is exactly
+        ``meshlib.put_global``."""
+        if not self._supervise:
+            return meshlib.put_global(src, self._epoch_sharding)
+
+        def attempt():
+            if self.chaos.enabled and \
+                    self.chaos.fire_range("put_fail", lo, hi):
+                self._record_chaos("put_fail", lo)
+                raise ChaosError(
+                    f"injected transient chunk device_put failure "
+                    f"(batches [{lo}, {hi}))")
+            delay = self.chaos.enabled and \
+                self.chaos.fire_range("put_delay", lo, hi)
+            with ftsup.Watchdog(self.ft.put_timeout_s,
+                                on_timeout=self._on_put_timeout):
+                if delay:
+                    self._record_chaos("put_delay", lo)
+                    time.sleep(2.0 * self.ft.put_timeout_s)
+                return meshlib.put_global(src, self._epoch_sharding)
+
+        return ftsup.call_with_retry(
+            attempt, attempts=self.ft.put_retries,
+            backoff_base_s=self.ft.backoff_base_s,
+            on_retry=self._on_put_retry)
+
+    def _iter_host_window_chunks(self, epoch: int, start_it: int = 0):
         """Chunked, double-buffered windowed host-augment pipeline (round
         6).  Round 5 staged each window as ONE blocking whole-window
         ``put_global``: the host->device link idled while the previous
@@ -843,36 +1113,96 @@ class Trainer:
         5).  Batches are augmented with their ABSOLUTE iteration index
         (``_host_aug_params``), so the crop/flip stream is bit-identical to
         the per-step and whole-window paths regardless of ``host_chunks``
-        or thread timing — pinned by tests/test_cli_and_profiling.py."""
+        or thread timing — pinned by tests/test_cli_and_profiling.py.
+
+        ``start_it`` (mid-epoch resume / producer restart) skips earlier
+        batches; chunk/window boundaries use ABSOLUTE batch arithmetic so
+        a restarted stream stays on the same window grid.  Under an
+        FTConfig the puts run supervised (``_supervised_put``), the arena
+        fence wait gets a watchdog, and ``verify_chunks`` checksums every
+        staged row at fill time and re-stages any row whose bytes changed
+        by flush time (the buffer-reuse corruption the ``corrupt_slot``
+        chaos site injects) — repair is a re-augment keyed by the same
+        absolute index, so the repaired stream is bit-identical."""
         cap = self._chunk_cap()
         arena = self._chunk_arena(cap)   # probe runs pre-thread, main thread
         nfull, _ = self._per_rank_batch_counts()
         nlim = nfull if self.limit_train_batches is None \
             else min(nfull, self.limit_train_batches)
+        fence_timeout = self.ft.put_timeout_s if self._supervise else None
+        stall_timeout = self.ft.stall_timeout_s if self._supervise else None
 
         def fill(emit):
             split = self.train_split
             chunk_x = None       # arena row block for the chunk being filled
             slot = -1
             chunk_y: list = []
-            filled = 0           # full batches consumed toward windows
+            chunk_meta: list = []   # (absolute it, cols) per filled row
+            chunk_sums: list = []   # fill-time crc32 per row (verify_chunks)
+
+            def fill_row(row, cols, it) -> None:
+                if self.augment:
+                    native.gather_augment_u8(
+                        split.images, cols,
+                        *self._host_aug_params(len(cols), epoch, it),
+                        out=row)
+                else:
+                    native.gather(split.images, cols, out=row)
+
+            def on_fence_timeout(elapsed_s):
+                if self.telemetry.enabled:
+                    self.telemetry.counter("staging_fence_timeout")
+                self.log(f"ft: arena slot fence exceeded its "
+                         f"{fence_timeout}s watchdog deadline")
+
+            def inject_and_verify(k: int, lo: int) -> None:
+                """Chaos byte corruption + checksum verify/repair, between
+                fill and put — the window where a buffer-reuse bug would
+                really strike."""
+                if self.chaos.enabled:
+                    for s in self.chaos.steps("corrupt_slot"):
+                        if lo <= s < lo + k and \
+                                self.chaos.fire("corrupt_slot", s):
+                            self._record_chaos("corrupt_slot", s)
+                            rng = self.chaos.rng("corrupt_slot", s)
+                            flat = chunk_x[s - lo].reshape(-1)
+                            pos = rng.integers(0, flat.size, size=8)
+                            flat[pos] ^= np.uint8(rng.integers(1, 256))
+                if not self._verify_chunks:
+                    return
+                for j in ftsup.verify_checksums(chunk_x[:k], chunk_sums):
+                    it_j, cols_j = chunk_meta[j]
+                    if self.telemetry.enabled:
+                        self.telemetry.counter("staging_corruption_repaired")
+                    self.log(f"ft: staged batch {it_j} failed its checksum; "
+                             f"re-staging from the resident dataset")
+                    fill_row(chunk_x[j], cols_j, it_j)
+                    if ftsup.verify_checksums([chunk_x[j]],
+                                              [chunk_sums[j]]):
+                        raise ftsup.StagingStalled(
+                            f"staged batch {it_j} fails its checksum even "
+                            f"after re-staging — arena memory is unsafe")
 
             def flush(last: bool) -> bool:
                 nonlocal chunk_x, slot
                 k = len(chunk_y)
                 if k == 0:
                     return True
+                lo = chunk_meta[0][0]
+                inject_and_verify(k, lo)
                 with self.telemetry.span("chunk_put", batches=k, last=last):
                     src = chunk_x[:k]
                     if self._staging_put_copies:
                         src = src.copy()
-                    x = meshlib.put_global(src, self._epoch_sharding)
-                    y = meshlib.put_global(np.asarray(chunk_y, np.int32),
-                                           self._epoch_sharding)
+                    x = self._supervised_put(src, lo, lo + k)
+                    y = self._supervised_put(
+                        np.asarray(chunk_y, np.int32), lo, lo + k)
                 if not self._staging_put_copies:
                     arena.retire(slot, x)
                 chunk_x, slot = None, -1
                 chunk_y.clear()
+                chunk_meta.clear()
+                chunk_sums.clear()
                 return emit(("chunk", (k, x, y, last)))
 
             for it, cols in enumerate(_shard_batch_cols(
@@ -882,6 +1212,13 @@ class Trainer:
                 if self.limit_train_batches is not None and \
                         it >= self.limit_train_batches:
                     break
+                if it < start_it:
+                    continue
+                if self.chaos.enabled and \
+                        self.chaos.fire("producer_crash", it):
+                    self._record_chaos("producer_crash", it)
+                    raise ChaosError(
+                        f"injected staging producer crash at batch {it}")
                 if len(cols) < self.global_batch:   # ragged tail (last)
                     if not flush(last=True):        # defensive: nlim
                         return                      # boundary flushed it
@@ -890,19 +1227,17 @@ class Trainer:
                         split.labels[cols], epoch, it))))
                     return
                 if chunk_x is None:
-                    slot, chunk_x = arena.acquire()
+                    slot, chunk_x = arena.acquire(
+                        fence_timeout_s=fence_timeout,
+                        on_timeout=on_fence_timeout)
                 with self.telemetry.span("host_augment"):
                     row = chunk_x[len(chunk_y)]
-                    if self.augment:
-                        native.gather_augment_u8(
-                            split.images, cols,
-                            *self._host_aug_params(len(cols), epoch, it),
-                            out=row)
-                    else:
-                        native.gather(split.images, cols, out=row)
+                    fill_row(row, cols, it)
                 chunk_y.append(split.labels[cols])
-                filled += 1
-                boundary = filled % WINDOW == 0 or filled == nlim
+                chunk_meta.append((it, cols))
+                if self._verify_chunks:
+                    chunk_sums.append(ftsup.batch_checksums([row])[0])
+                boundary = (it + 1) % WINDOW == 0 or (it + 1) == nlim
                 if (len(chunk_y) == cap or boundary) and \
                         not flush(last=boundary):
                     return
@@ -911,7 +1246,55 @@ class Trainer:
         # chunks — same two-windows-ahead depth round 5's PREFETCH_DEPTH=2
         # gave whole-window items.
         return self._prefetch_iter(
-            fill, depth=2 * len(self._chunk_plan(WINDOW)))
+            fill, depth=2 * len(self._chunk_plan(WINDOW)),
+            stall_timeout_s=stall_timeout)
+
+    def _iter_host_window_chunks_sync(self, epoch: int, start_it: int = 0):
+        """Degraded-mode staging: the chunked pipeline's item protocol
+        (``("chunk", ...)``/``("tail", ...)``) produced SYNCHRONOUSLY on
+        the consumer thread — no producer thread, no arena, one k=1 chunk
+        per batch from a private buffer.  This is the graceful-degradation
+        target after staging failures exhaust their restart budget: it
+        loses the transfer/compute overlap but keeps the stream
+        BIT-IDENTICAL — augmentation is keyed by the absolute batch index
+        and window results are chunk-composition independent (the K1-vs-K2
+        pin in tests/test_cli_and_profiling.py), so the windows dispatched
+        downstream are exactly the ones the healthy pipeline would have
+        dispatched."""
+        nfull, _ = self._per_rank_batch_counts()
+        nlim = nfull if self.limit_train_batches is None \
+            else min(nfull, self.limit_train_batches)
+        split = self.train_split
+        for it, cols in enumerate(_shard_batch_cols(
+                len(split.labels), self.world, self.global_batch,
+                epoch, shuffle=True, seed=self.seed,
+                reshuffle_each_epoch=self.reshuffle_each_epoch)):
+            if self.limit_train_batches is not None and \
+                    it >= self.limit_train_batches:
+                break
+            if it < start_it:
+                continue
+            if len(cols) < self.global_batch:   # ragged tail
+                yield ("tail", (it, *self._put_host_augmented(
+                    native.gather(split.images, cols),
+                    split.labels[cols], epoch, it)))
+                return
+            buf = np.empty((1, self.global_batch, 32, 32, 3), np.uint8)
+            with self.telemetry.span("host_augment"):
+                if self.augment:
+                    native.gather_augment_u8(
+                        split.images, cols,
+                        *self._host_aug_params(len(cols), epoch, it),
+                        out=buf[0])
+                else:
+                    native.gather(split.images, cols, out=buf[0])
+            with self.telemetry.span("chunk_put", batches=1, degraded=True):
+                x = meshlib.put_global(buf, self._epoch_sharding)
+                y = meshlib.put_global(
+                    np.asarray([split.labels[cols]], np.int32),
+                    self._epoch_sharding)
+            last = (it + 1) % WINDOW == 0 or (it + 1) == nlim
+            yield ("chunk", (1, x, y, last))
 
     def _per_rank_batch_counts(self):
         """(nfull, tail_per): full per-rank batch count and ragged per-rank
@@ -942,12 +1325,22 @@ class Trainer:
             nfull = min(nfull, self.limit_train_batches)
         return self._window_shape_set(nfull)
 
-    def _train_model_host_windowed(self, epoch: int) -> WindowedTimers:
+    def _train_model_host_windowed(self, epoch: int,
+                                   start_step: int = 0) -> WindowedTimers:
         """Windowed host-augment epoch: scanned dispatches over
         chunk-staged C++-augmented buffers (``_iter_host_window_chunks``),
         the reference's print/timing schedule.  The default host-augment
         mode since round 5 — the per-step path remains under
-        ``profile_phases`` (where per-batch dispatch is the point)."""
+        ``profile_phases`` (where per-batch dispatch is the point).
+
+        Under an FTConfig this is also the supervised path: a staging
+        failure (producer death, injected or real; consumer stall past the
+        deadline) discards the partially-assembled window and restarts the
+        producer from the last TRAINED step — once — then degrades to
+        synchronous per-batch staging (``_iter_host_window_chunks_sync``).
+        Both recoveries preserve the training stream bitwise: re-staged
+        batches are keyed by absolute index, and ``trained`` only advances
+        at dispatched-window granularity, so nothing is half-applied."""
         if self.telemetry.enabled:
             self._emit_collective_telemetry()
         timers = WindowedTimers(self.log, telemetry=self.telemetry,
@@ -990,25 +1383,75 @@ class Trainer:
                             *[_sds(c, (), jnp.int32)
                               for c in pattern]).compile()
                     self._warmed_window_shapes.add(akey)
-        chunk_iter = self._iter_host_window_chunks(epoch)
+        trained = start_step            # absolute batches applied to state
+        restarts_left = self.ft.producer_restarts if self._supervise else 0
+        self._check_preempt(epoch, trained)
+
+        def make_iter(start):
+            if self.staging_degraded:
+                return self._iter_host_window_chunks_sync(epoch, start)
+            return self._iter_host_window_chunks(epoch, start)
+
+        chunk_iter = make_iter(trained)
         chunks_x, chunks_y = [], []
         while True:
-            # chunk_wait: how long the consumer stalls on the producer —
-            # with healthy overlap this is ~0 except at the first window.
-            with self.telemetry.span("chunk_wait"):
-                item = next(chunk_iter, None)
+            try:
+                # chunk_wait: how long the consumer stalls on the producer —
+                # with healthy overlap this is ~0 except at the first window.
+                with self.telemetry.span("chunk_wait"):
+                    item = next(chunk_iter, None)
+            except Exception as e:
+                # Staging failed: the producer died (ChaosError or a real
+                # exception re-raised by _prefetch_iter) or the consumer's
+                # stall deadline fired (StagingStalled).  Nothing trained
+                # from the lost chunks — drop the partial window and
+                # re-stage from ``trained``; the counter-keyed stream makes
+                # the retake bit-identical.
+                if not self._supervise:
+                    raise
+                self.producer_failures += 1
+                if self.telemetry.enabled:
+                    self.telemetry.counter("producer_failure",
+                                           error=type(e).__name__)
+                try:
+                    chunk_iter.close()
+                except Exception:  # pragma: no cover - best-effort cleanup
+                    pass
+                chunks_x, chunks_y = [], []
+                if restarts_left > 0:
+                    restarts_left -= 1
+                    if self.telemetry.enabled:
+                        self.telemetry.counter("producer_restart")
+                    self.log(f"ft: staging failed at step {trained} "
+                             f"({type(e).__name__}: {e}); restarting the "
+                             f"producer from step {trained}")
+                    chunk_iter = make_iter(trained)
+                    continue
+                self.staging_degraded = True
+                if self.telemetry.enabled:
+                    self.telemetry.counter("staging_degraded")
+                self.log(f"ft: staging failed again at step {trained} "
+                         f"({type(e).__name__}: {e}); restart budget "
+                         f"exhausted — degrading to synchronous per-batch "
+                         f"staging (stream unchanged, overlap lost)")
+                chunk_iter = make_iter(trained)
+                continue
             if item is None:
                 break
             kind, payload = item
             if kind == "tail":   # ragged tail through its own per-step shape
                 it, x, y = payload
                 t0 = time.time()
-                self.state, loss = self.train_step_host(
+                out = self.train_step_host(
                     self.state, jax.random.fold_in(key, it), x, y)
-                loss = float(loss)  # value fetch = fence
+                loss, ok = self._fetch_step(out)  # value fetch = fence
                 # steady=False: lone per-dispatch sample carries the fixed
                 # dispatch latency the amortized window samples do not.
                 timers.record(loss, time.time() - t0, steady=False)
+                trained = it + 1
+                if ok is not None:
+                    self._handle_nonfinite(np.asarray([ok]), epoch)
+                self._check_preempt(epoch, trained)
                 continue
             k, x, y, last = payload
             chunks_x.append(x)
@@ -1029,13 +1472,30 @@ class Trainer:
             chunks_x, chunks_y = [], []
             w = int(xw.shape[0])
             t0 = time.time()
-            self.state, losses = self.train_window_host(
-                self.state, key, xw, yw, jnp.int32(0),
+            # start=trained: dynamic_slice clamps it to 0 for these
+            # exact-length window arrays (value-identical), while making
+            # the scan's step indices ABSOLUTE — which is what the
+            # compiled-in nonfinite-chaos masks are keyed by.
+            out = self.train_window_host(
+                self.state, key, xw, yw, jnp.int32(trained),
                 jnp.zeros((w,), jnp.int8))
+            if self._guard_on:
+                self.state, losses, oks = out
+            else:
+                (self.state, losses), oks = out, None
             losses = np.asarray(losses)  # value fetch = fence
             per_iter = (time.time() - t0) / w
             for loss in losses:
                 timers.record(float(loss), per_iter)
+            if self._nf_chaos_steps and self.chaos.fire_range(
+                    "nonfinite_grad", trained, trained + w):
+                self._record_chaos("nonfinite_grad", next(
+                    s for s in self._nf_chaos_steps
+                    if trained <= s < trained + w))
+            trained += w
+            if oks is not None:
+                self._handle_nonfinite(oks, epoch)
+            self._check_preempt(epoch, trained)
         self.last_epoch_timers = timers
         return timers
 
@@ -1110,8 +1570,19 @@ class Trainer:
         With ``profile_dir`` set, the first trained epoch is captured as a
         ``jax.profiler`` trace (XPlane; viewable in TensorBoard/Perfetto) —
         the superset of the reference's print-based timers promised in
-        SURVEY.md §5."""
+        SURVEY.md §5.
+
+        Preemption (ft/): while running, SIGTERM/SIGINT request a stop at
+        the next step boundary — the in-flight dispatch finishes, an
+        EMERGENCY mid-epoch checkpoint (state + (epoch, step)) is written
+        if a checkpoint dir is configured, and run() returns with
+        ``self.preempted`` set.  A later run() against the same dir resumes
+        from that exact step — every PRNG fold and the sampler are keyed by
+        (seed, epoch, absolute step), so the interrupted+resumed run is
+        bitwise identical to an uninterrupted one (pinned by
+        tests/test_ft.py)."""
         start_epoch = 0
+        start_step = 0
         mngr = None
         if checkpoint_dir is not None:
             from .checkpoint import CheckpointManager
@@ -1132,21 +1603,64 @@ class Trainer:
                 "limit_train_batches": self.limit_train_batches,
                 "real_data": self.real_data,
                 "state_digest": str(param_tree)})
-            if mngr.latest_epoch() is not None:
+            # Mid-epoch (emergency) checkpoints outrank the epoch series
+            # exactly when they are AHEAD of it: the emergency save for
+            # epoch k is newer than the epoch k-1 save it coexists with,
+            # and stale (cleared, but tolerate a crash between save and
+            # clear) once epoch k itself completes.
+            mid = mngr.latest_mid_epoch()
+            le = mngr.latest_epoch()
+            if mid is not None and (le is None or mid[0] > le):
+                self.state, start_epoch, start_step = \
+                    mngr.restore_mid_epoch(self.state)
+                self.log(f"Resumed from mid-epoch checkpoint: epoch "
+                         f"{start_epoch}, step {start_step}")
+            elif le is not None:
                 self.state, start_epoch = mngr.restore(self.state)
                 self.log(f"Resumed from checkpoint: epoch {start_epoch}")
+            if self._nf_policy == "restore" and \
+                    (mid is not None or le is not None):
+                self._snapshot_rollback()   # rollback point = restored state
         try:
+            if mngr is not None or self._supervise:
+                self._preempt_guard = PreemptionGuard(log=self.log).install()
             if start_epoch >= epochs:
                 self.log(f"All {epochs} epoch(s) already checkpointed; "
                          f"nothing to run"
                          + (" (profile_dir ignored)" if profile_dir else ""))
             for epoch in range(start_epoch, epochs):
                 t0 = time.time()
-                if profile_dir is not None and epoch == start_epoch:
-                    with jax.profiler.trace(profile_dir):
-                        self.train_model(epoch)
-                else:
-                    self.train_model(epoch)
+                try:
+                    if profile_dir is not None and epoch == start_epoch:
+                        with jax.profiler.trace(profile_dir):
+                            self.train_model(epoch, start_step=start_step)
+                    else:
+                        self.train_model(epoch, start_step=start_step)
+                except PreemptedError as e:
+                    self.preempted = True
+                    if self.telemetry.enabled:
+                        self.telemetry.counter("preemptions",
+                                               epoch=e.epoch, step=e.step)
+                    if mngr is not None:
+                        with self.telemetry.span("checkpoint_save_mid_epoch",
+                                                 epoch=e.epoch, step=e.step):
+                            mngr.save_mid_epoch(
+                                e.epoch, e.step, self.state,
+                                data_order={
+                                    "seed": self.seed,
+                                    "epoch": e.epoch,
+                                    "step": e.step,
+                                    "reshuffle_each_epoch":
+                                        self.reshuffle_each_epoch,
+                                })
+                        self.log(f"Preempted at epoch {e.epoch} step "
+                                 f"{e.step}; emergency checkpoint saved")
+                    else:
+                        self.log(f"Preempted at epoch {e.epoch} step "
+                                 f"{e.step}; no checkpoint dir — progress "
+                                 f"since the last save is lost")
+                    return
+                start_step = 0
                 self.log(f"Training time after {epoch + 1} epoch is "
                          f"{time.time() - t0}")
                 if self.telemetry.enabled:
@@ -1157,7 +1671,21 @@ class Trainer:
                 if mngr is not None:
                     with self.telemetry.span("checkpoint_save", epoch=epoch):
                         mngr.save(epoch, self.state)
+                    mngr.clear_mid_epoch()
+                    if self._nf_policy == "restore":
+                        self._snapshot_rollback()   # advance rollback point
+                if self._preempt_guard is not None and \
+                        self._preempt_guard.requested:
+                    # The signal landed during eval/save: the epoch boundary
+                    # just persisted IS the resume point — stop cleanly.
+                    self.preempted = True
+                    self.log(f"Preemption requested; stopping after epoch "
+                             f"{epoch} completed")
+                    return
         finally:
+            if self._preempt_guard is not None:
+                self._preempt_guard.uninstall()
+                self._preempt_guard = None
             if mngr is not None:
                 mngr.close()
 
@@ -1264,9 +1792,10 @@ class Trainer:
         for n in (w, half):
             np.asarray(fwd_window(self.state, key, epoch_images,
                                   epoch_labels, jnp.int32(0), lengths[n]))
-            self.state, losses = self.train_window(
+            out = self.train_window(
                 self.state, key, epoch_images, epoch_labels, jnp.int32(0),
                 lengths[n])
+            self.state, losses = out[0], out[1]  # tolerate guarded arity
             np.asarray(losses)
         totals = {("fwd", w): [], ("fwd", half): [],
                   ("step", w): [], ("step", half): []}
@@ -1278,9 +1807,10 @@ class Trainer:
                                       epoch_labels, start, lengths[n]))
                 totals[("fwd", n)].append(time.time() - t0)
                 t0 = time.time()
-                self.state, losses = self.train_window(
+                out = self.train_window(
                     self.state, key, epoch_images, epoch_labels, start,
                     lengths[n])
+                self.state, losses = out[0], out[1]
                 np.asarray(losses)  # value fetch = completion fence
                 totals[("step", n)].append(time.time() - t0)
         self.state = state_snapshot   # measurement leaves no training trace
@@ -1353,9 +1883,10 @@ class Trainer:
             np.asarray(k)  # value fetch: keep transfers out of timed region
 
         def dispatch(start, wi):
-            self.state, losses = self.train_window(
+            out = self.train_window(
                 self.state, keys[wi], epoch_images,
                 epoch_labels, jnp.int32(start), length_arr)
+            self.state, losses = out[0], out[1]  # tolerate guarded arity
             return losses
 
         # Window 0: compile + warmup (excluded, as the reference excludes its

@@ -31,6 +31,7 @@ except ImportError:                      # jax < 0.6 ships it as experimental
     from jax.experimental.shard_map import shard_map
 
 from ..data import augment as aug
+from ..ft import guard as ftguard
 from ..ops import sgd
 from ..ops.loss import cross_entropy
 from .. import parallel
@@ -109,16 +110,35 @@ def init_train_state(init_fn, key: jax.Array) -> TrainState:
                       opt_state=sgd.init(params))
 
 
+def _guarded_update(params, bn_state, opt_state, grads, cfg, loss, new_bn):
+    """The non-finite-guarded tail of a train step: one finiteness scalar
+    decides, branch-free, between the SGD update and keeping the ENTIRE
+    prior state (params, BN stats, momentum) — see ft/guard.py."""
+    ok = ftguard.finite_ok(loss, grads)
+    upd_params, upd_opt = sgd.update(params, grads, opt_state, cfg)
+    return (ftguard.select_update(ok, upd_params, params),
+            ftguard.select_update(ok, new_bn, bn_state),
+            ftguard.select_update(ok, upd_opt, opt_state), ok)
+
+
 def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
                     mesh: Mesh, cfg: sgd.SGDConfig = sgd.SGDConfig(),
-                    *, augment: bool = True,
-                    compute_dtype=None) -> Callable:
+                    *, augment: bool = True, compute_dtype=None,
+                    nonfinite_guard: bool = False,
+                    inject_nonfinite: bool = False) -> Callable:
     """Build the jitted train step.
 
     step(state, key, images[B,32,32,3], labels[B]) -> (state, loss)
     with B = global batch, sharded over the mesh's "data" axis; images are
     uint8 (``augment`` True/False: transform on device) or preprocessed
     float32 (``augment="host"`` — see ``_prepare``).
+
+    ``nonfinite_guard`` compiles in the finiteness check + branch-free
+    conditional update (ft/guard.py) and the step returns an extra
+    replicated ``ok`` scalar: (state, loss, ok).  ``inject_nonfinite``
+    (chaos only) unconditionally poisons the gradients with NaN — the
+    Trainer swaps this variant in for exactly one batch.  Both default
+    off, leaving the program identical to the unguarded build.
 
     The ``local`` strategy (reference Part 1: single process, no process
     group — ``/root/reference/src/Part 1/main.py``) compiles WITHOUT
@@ -141,6 +161,13 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
 
             (loss, new_bn), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
+            if inject_nonfinite:
+                grads = ftguard.inject_nan(grads)
+            if nonfinite_guard:
+                p, bn, opt, ok = _guarded_update(
+                    state.params, state.bn_state, state.opt_state, grads,
+                    cfg, loss, new_bn)
+                return TrainState(p, bn, opt), loss, ok
             new_params, new_opt = sgd.update(state.params, grads,
                                              state.opt_state, cfg)
             return TrainState(new_params, new_bn, new_opt), loss
@@ -166,18 +193,38 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
         params_var = jax.tree.map(pvary, params)
         (loss, new_bn), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params_var)
+        if inject_nonfinite:
+            # Poison BEFORE the gradient sync — a real overflow is born on
+            # a shard and spreads through the collective, and so must the
+            # injected one.
+            grads = ftguard.inject_nan(grads)
         grads = strategy(grads, DATA_AXIS)
-        new_params, new_opt = sgd.update(params, grads, opt_state, cfg)
         new_bn = jax.tree.map(lambda a: lax.pmean(a, DATA_AXIS), new_bn)
         loss = lax.pmean(loss, DATA_AXIS)
+        if nonfinite_guard:
+            return _guarded_update(params, bn_state, opt_state, grads, cfg,
+                                   loss, new_bn) + (loss,)
+        new_params, new_opt = sgd.update(params, grads, opt_state, cfg)
         return new_params, new_bn, new_opt, loss
 
+    out_specs = ((P(), P(), P(), P(), P()) if nonfinite_guard
+                 else (P(), P(), P(), P()))
     mapped = shard_map(
         shard_body, mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(), P(), P(), P()),
+        out_specs=out_specs,
         **_SHARD_MAP_KW,
     )
+
+    if nonfinite_guard:
+        @jax.jit
+        def guarded_step(state: TrainState, key, images, labels):
+            p, bn, opt, ok, loss = mapped(
+                state.params, state.bn_state, state.opt_state, key, images,
+                labels)
+            return TrainState(p, bn, opt), loss, ok
+
+        return guarded_step
 
     @jax.jit
     def step(state: TrainState, key, images, labels):
@@ -192,7 +239,9 @@ def make_train_window(apply_fn: Callable,
                       strategy: parallel.strategies.Strategy, mesh: Mesh,
                       cfg: sgd.SGDConfig = sgd.SGDConfig(),
                       *, augment: bool = True,
-                      compute_dtype=None) -> Callable:
+                      compute_dtype=None,
+                      nonfinite_guard: bool = False,
+                      nonfinite_chaos_steps=()) -> Callable:
     """Windowed train step: W iterations per dispatch via ``lax.scan``.
 
     window(state, key, epoch_images[NB,B,32,32,3], epoch_labels[NB,B],
@@ -206,7 +255,16 @@ def make_train_window(apply_fn: Callable,
     reporting window — the granularity the reference itself reports at
     (``/root/reference/src/Part 1/main.py:47-57``).  State buffers are
     donated (the optimizer update is in-place in XLA terms).
+
+    ``nonfinite_guard`` adds the per-iteration finiteness check + select
+    (ft/guard.py); the window then returns (state, losses[W], oks[W]).
+    ``nonfinite_chaos_steps`` (static ints, chaos only) poisons gradients
+    with NaN at those ABSOLUTE batch indices — the scan folds the absolute
+    index, so one compiled program injects at exactly the planned batches
+    regardless of window boundaries.  Both default off/empty: the program
+    is identical to the unguarded build.
     """
+    chaos_steps = tuple(int(s) for s in nonfinite_chaos_steps)
 
     def scan_one(apply_fn, strategy_fn, axis_ok):
         def one(carry, xs):
@@ -231,12 +289,21 @@ def make_train_window(apply_fn: Callable,
                 pvary, params)
             (loss, new_bn), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(diff_params)
+            if chaos_steps:
+                mask = (idx == chaos_steps[0])
+                for s in chaos_steps[1:]:
+                    mask = mask | (idx == s)
+                grads = ftguard.inject_nan(grads, mask=mask)
             grads = strategy_fn(grads)
-            new_params, new_opt = sgd.update(params, grads, opt_state, cfg)
             if axis_ok:
                 new_bn = jax.tree.map(
                     lambda a: lax.pmean(a, DATA_AXIS), new_bn)
                 loss = lax.pmean(loss, DATA_AXIS)
+            if nonfinite_guard:
+                p, bn, opt, ok = _guarded_update(
+                    params, bn_state, opt_state, grads, cfg, loss, new_bn)
+                return (p, bn, opt, key), (loss, ok)
+            new_params, new_opt = sgd.update(params, grads, opt_state, cfg)
             return (new_params, new_bn, new_opt, key), loss
         return one
 
@@ -252,9 +319,12 @@ def make_train_window(apply_fn: Callable,
                        (lambda g: g) if single
                        else (lambda g: strategy(g, DATA_AXIS)),
                        axis_ok=not single)
-        (p, bn, opt, _), losses = lax.scan(
+        (p, bn, opt, _), ys = lax.scan(
             one, (params, bn_state, opt_state, key), (imgs, labs, idxs))
-        return p, bn, opt, losses
+        if nonfinite_guard:
+            losses, oks = ys
+            return p, bn, opt, losses, oks
+        return p, bn, opt, ys
 
     if single:
         if mesh.devices.size != 1:
@@ -263,28 +333,29 @@ def make_train_window(apply_fn: Callable,
         @partial(jax.jit, donate_argnums=(0,))
         def window(state: TrainState, key, epoch_images, epoch_labels,
                    start, length_arr):
-            p, bn, opt, losses = window_body(
+            out = window_body(
                 state.params, state.bn_state, state.opt_state, key,
                 epoch_images, epoch_labels, start, length_arr)
-            return TrainState(p, bn, opt), losses
+            return (TrainState(*out[:3]),) + tuple(out[3:])
 
         return window
 
+    out_specs = ((P(), P(), P(), P(), P()) if nonfinite_guard
+                 else (P(), P(), P(), P()))
     mapped = shard_map(
         window_body, mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(None, DATA_AXIS), P(None, DATA_AXIS),
                   P(), P()),
-        out_specs=(P(), P(), P(), P()),
+        out_specs=out_specs,
         **_SHARD_MAP_KW,
     )
 
     @partial(jax.jit, donate_argnums=(0,))
     def window(state: TrainState, key, epoch_images, epoch_labels, start,
                length_arr):
-        p, bn, opt, losses = mapped(state.params, state.bn_state,
-                                    state.opt_state, key, epoch_images,
-                                    epoch_labels, start, length_arr)
-        return TrainState(p, bn, opt), losses
+        out = mapped(state.params, state.bn_state, state.opt_state, key,
+                     epoch_images, epoch_labels, start, length_arr)
+        return (TrainState(*out[:3]),) + tuple(out[3:])
 
     return window
 

@@ -168,15 +168,25 @@ def collective_chain_depth(hlo_text: str) -> int:
         d: Dict[str, int] = {}
         best = 0
         for name, (op, refs) in instrs.items():
-            w = _collective_weight(op)
+            w0 = _collective_weight(op)
+            # Operand chains and called-computation internals COMPOSE: the
+            # callee runs after the instruction's operands are ready, so an
+            # instruction whose deepest operand chain is A and whose called
+            # computation (while body, reducer, fusion) is internally B
+            # deep sits at A + B (+ its own weight) — taking max(A, B)
+            # undercounts every collective chain that FEEDS a
+            # collective-bearing called computation (pinned by
+            # tests/test_hlo_stats.py).
+            operand_chain = 0
+            callee_depth = 0
             for r in refs:
                 if r in d:
-                    w = max(w, _collective_weight(op) + d[r])
+                    operand_chain = max(operand_chain, d[r])
                 elif r in comps and r != cname:
-                    w = max(w, _collective_weight(op)
-                            + depth_of_comp(r, stack + (cname,)))
-            d[name] = w
-            best = max(best, w)
+                    callee_depth = max(callee_depth,
+                                       depth_of_comp(r, stack + (cname,)))
+            d[name] = w0 + operand_chain + callee_depth
+            best = max(best, d[name])
         comp_depth[cname] = best
         return best
 

@@ -1,0 +1,602 @@
+"""Fault-tolerance layer (ft/) tests: the deterministic chaos harness, the
+supervised staging pipeline, the non-finite step guard, preemption-safe
+mid-epoch resume, and the atomic-artifact/truncated-telemetry satellites.
+
+The load-bearing pins are BITWISE: every recovery path that promises to
+preserve the training stream (producer restart, degraded staging, checksum
+repair, put retry, mid-epoch resume) must leave the final TrainState
+byte-identical to an undisturbed run of the SAME program configuration.
+Guard-on vs guard-off runs compile different step programs (XLA fuses them
+differently, ~1e-10 apart), so no test compares across that boundary.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+
+import jax
+import pytest
+
+import cs744_ddp_tpu.train.loop as looplib
+from cs744_ddp_tpu.data import cifar10
+from cs744_ddp_tpu.ft import (NULL_CHAOS, ChaosPlan, FTConfig,
+                              NonFiniteError, NullChaos, StagingStalled,
+                              Watchdog, batch_checksums, call_with_retry,
+                              verify_checksums)
+from cs744_ddp_tpu.obs.telemetry import atomic_write_json, read_events_jsonl
+from cs744_ddp_tpu.train.checkpoint import CheckpointManager
+from cs744_ddp_tpu.train.loop import Trainer
+
+from tinynet import tiny_cnn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- chaos plan ---------------------------------------------------------------
+
+def test_chaos_parse_specs_and_empty():
+    plan = ChaosPlan.parse(["put_fail:2", "corrupt_slot:3:7"])
+    assert plan.enabled
+    assert plan.spec() == [
+        {"site": "put_fail", "step": 2, "seed": 0},
+        {"site": "corrupt_slot", "step": 3, "seed": 7}]
+    # Empty/None parse to the stateless disabled singleton, not a plan.
+    assert ChaosPlan.parse(None) is NULL_CHAOS
+    assert ChaosPlan.parse([]) is NULL_CHAOS
+
+
+def test_chaos_parse_rejects_bad_specs():
+    with pytest.raises(ValueError, match="SITE:step"):
+        ChaosPlan.parse(["put_fail"])
+    with pytest.raises(ValueError, match="integers"):
+        ChaosPlan.parse(["put_fail:x"])
+    with pytest.raises(ValueError, match="unknown chaos site"):
+        ChaosPlan.parse(["meteor_strike:3"])
+    with pytest.raises(ValueError, match=">= 0"):
+        ChaosPlan.parse(["put_fail:-1"])
+
+
+def test_chaos_fire_is_one_shot_and_recorded():
+    plan = ChaosPlan.parse(["producer_crash:4"])
+    assert not plan.fire("producer_crash", 3)
+    assert plan.fire("producer_crash", 4)
+    assert not plan.fire("producer_crash", 4)      # one-shot
+    assert not plan.fire("put_fail", 4)            # other sites unaffected
+    assert plan.fired == [("producer_crash", 4)]
+
+
+def test_chaos_fire_range_and_reached():
+    plan = ChaosPlan.parse(["put_fail:5", "preempt:3"])
+    assert not plan.fire_range("put_fail", 0, 5)   # half-open: 5 excluded
+    assert plan.fire_range("put_fail", 5, 8)
+    assert not plan.fire_range("put_fail", 5, 8)
+    assert not plan.fire_reached("preempt", 2)
+    assert plan.fire_reached("preempt", 7)         # >= the planned step
+    assert not plan.fire_reached("preempt", 7)
+    assert plan.fired == [("put_fail", 5), ("preempt", 3)]
+
+
+def test_chaos_steps_lists_planned_not_fired():
+    plan = ChaosPlan.parse(["put_fail:1", "put_fail:9", "preempt:2"])
+    assert plan.steps("put_fail") == (1, 9)
+    plan.fire("put_fail", 1)
+    assert plan.steps("put_fail") == (1, 9)        # fired entries stay listed
+
+
+def test_chaos_rng_deterministic_in_seed_site_step():
+    a = ChaosPlan.parse(["corrupt_slot:3:7"]).rng("corrupt_slot", 3)
+    b = ChaosPlan.parse(["corrupt_slot:3:7"]).rng("corrupt_slot", 3)
+    c = ChaosPlan.parse(["corrupt_slot:3:8"]).rng("corrupt_slot", 3)
+    xs, ys, zs = (r.integers(0, 2**31, size=16) for r in (a, b, c))
+    np.testing.assert_array_equal(xs, ys)
+    assert not np.array_equal(xs, zs)
+
+
+def test_chaos_fire_thread_safe_exactly_once():
+    plan = ChaosPlan.parse(["producer_crash:0"])
+    hits, barrier = [], threading.Barrier(8)
+
+    def race():
+        barrier.wait()
+        if plan.fire("producer_crash", 0):
+            hits.append(1)
+
+    threads = [threading.Thread(target=race) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(hits) == 1
+
+
+def test_null_chaos_is_stateless_and_all_false():
+    assert NullChaos.__slots__ == ()
+    assert NULL_CHAOS.enabled is False
+    with pytest.raises(AttributeError):
+        NULL_CHAOS.fired = []                      # no state can ever attach
+    assert NULL_CHAOS.fire("producer_crash", 0) is False
+    assert NULL_CHAOS.fire_range("put_fail", 0, 10) is False
+    assert NULL_CHAOS.fire_reached("preempt", 10) is False
+    assert NULL_CHAOS.steps("corrupt_slot") == ()
+    assert NULL_CHAOS.spec() == []
+
+
+def test_trainer_without_ft_compiles_no_supervision(tmp_path, mesh4):
+    """ft=None is the zero-cost path: the chaos hook is the disabled
+    singleton and none of the supervision/guard machinery is armed."""
+    tr = Trainer(model=tiny_cnn(), strategy="allreduce", mesh=mesh4,
+                 global_batch=64, data_dir=str(tmp_path), augment=True,
+                 host_augment=True, log=lambda s: None)
+    assert tr.chaos is NULL_CHAOS
+    assert tr._supervise is False
+    assert tr._guard_on is False
+    assert tr._verify_chunks is False
+    assert tr.staging_degraded is False
+
+
+def test_chaos_nonfinite_requires_guard(tmp_path, mesh4):
+    with pytest.raises(ValueError, match="nonfinite"):
+        Trainer(model=tiny_cnn(), strategy="allreduce", mesh=mesh4,
+                global_batch=64, data_dir=str(tmp_path), augment=True,
+                host_augment=True, log=lambda s: None,
+                ft=FTConfig(chaos=ChaosPlan.parse(["nonfinite_grad:1"])))
+
+
+# -- supervision primitives ---------------------------------------------------
+
+def test_watchdog_fires_once_detection_only():
+    fired = []
+    with Watchdog(0.02, on_timeout=fired.append) as wd:
+        time.sleep(0.15)                           # body overruns but runs on
+        body_done = True
+    assert body_done and wd.fired and len(fired) == 1
+    assert fired[0] >= 0.02
+
+
+def test_watchdog_quiet_when_body_is_fast():
+    fired = []
+    with Watchdog(5.0, on_timeout=fired.append) as wd:
+        pass
+    assert not wd.fired and fired == []
+    with Watchdog(None, on_timeout=fired.append):  # disabled deadline
+        pass
+    assert fired == []
+
+
+def test_call_with_retry_backoff_and_callback_order():
+    calls, retries, naps = [], [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError(f"transient {len(calls)}")
+        return "ok"
+
+    out = call_with_retry(flaky, attempts=4, backoff_base_s=0.05,
+                          on_retry=lambda a, e: retries.append((a, str(e))),
+                          sleep=naps.append)
+    assert out == "ok" and len(calls) == 3
+    assert retries == [(0, "transient 1"), (1, "transient 2")]
+    assert naps == [0.05, 0.1]                     # base * 2**attempt
+
+
+def test_call_with_retry_final_failure_propagates():
+    with pytest.raises(OSError, match="always"):
+        call_with_retry(lambda: (_ for _ in ()).throw(OSError("always")),
+                        attempts=3, backoff_base_s=0.0, sleep=lambda s: None)
+    with pytest.raises(ValueError, match="attempts"):
+        call_with_retry(lambda: 1, attempts=0, backoff_base_s=0.0)
+
+
+def test_checksums_detect_single_flipped_byte():
+    rows = [np.arange(64, dtype=np.uint8).reshape(8, 8) for _ in range(3)]
+    sums = batch_checksums(rows)
+    assert verify_checksums(rows, sums) == []
+    rows[1][3, 4] ^= 0x40
+    assert verify_checksums(rows, sums) == [1]
+    rows[1][3, 4] ^= 0x40                          # repair restores the sum
+    assert verify_checksums(rows, sums) == []
+
+
+# -- atomic artifact writes (satellite: kill-mid-write) -----------------------
+
+def test_atomic_write_json_survives_sigkill_mid_write(tmp_path):
+    """A process SIGKILLed at the worst instant — partial temp file written,
+    atomic replace not yet reached — must leave the previous artifact
+    intact and parseable (this is the window os.replace protects)."""
+    path = tmp_path / "artifact.json"
+    script = tmp_path / "killer.py"
+    script.write_text(textwrap.dedent(f"""\
+        import os, signal, sys
+        sys.path.insert(0, {REPO!r})
+        from cs744_ddp_tpu.obs.telemetry import atomic_write_json
+        path = sys.argv[1]
+        atomic_write_json(path, {{"generation": 0, "complete": True}})
+        # Second write: die at the worst instant — the temp file holds a
+        # torn half-document, the replace has not happened.
+        tmp = f"{{path}}.{{os.getpid()}}.tmp"
+        with open(tmp, "w") as f:
+            f.write('{{"generation": 1, "comp')
+            f.flush()
+            os.fsync(f.fileno())
+            os.kill(os.getpid(), signal.SIGKILL)
+        """))
+    proc = subprocess.run([sys.executable, str(script), str(path)],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    with open(path) as f:
+        assert json.load(f) == {"generation": 0, "complete": True}
+    # The orphaned temp file must not confuse a later writer.
+    atomic_write_json(str(path), {"generation": 2})
+    with open(path) as f:
+        assert json.load(f) == {"generation": 2}
+
+
+def test_atomic_write_json_cleans_tmp_on_serialization_error(tmp_path):
+    path = str(tmp_path / "artifact.json")
+    atomic_write_json(path, {"v": 0})
+    with pytest.raises(TypeError):
+        # Non-string keys raise MID-dump, after partial bytes hit the temp
+        # file; the artifact must keep its previous content and the temp
+        # file must be cleaned up.
+        atomic_write_json(path, {"v": 1, ("bad", "key"): 2})
+    with open(path) as f:
+        assert json.load(f) == {"v": 0}
+    assert [p for p in os.listdir(tmp_path) if p.endswith(".tmp")] == []
+
+
+# -- truncated telemetry (satellite: report tolerates killed runs) ------------
+
+def test_read_events_jsonl_tolerates_truncated_tail(tmp_path):
+    p = str(tmp_path / "events.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"kind": "step", "iter": 1}) + "\n")
+        f.write(json.dumps({"kind": "counter", "name": "c"}) + "\n")
+        f.write('{"kind": "step", "it')            # run killed mid-write
+    warns = []
+    events, n_bad = read_events_jsonl(p, warn=warns.append)
+    assert [e["kind"] for e in events] == ["step", "counter"]
+    assert n_bad == 1
+    assert len(warns) == 1 and "undecodable" in warns[0]
+    # Missing file: empty, not an error (a run killed before any event).
+    assert read_events_jsonl(str(tmp_path / "absent.jsonl")) == ([], 0)
+
+
+def test_telemetry_report_surfaces_truncated_lines(tmp_path, monkeypatch):
+    from cs744_ddp_tpu.obs.telemetry import Telemetry
+    monkeypatch.syspath_prepend(os.path.join(REPO, "tools"))
+    import telemetry_report
+
+    d = str(tmp_path / "run")
+    tel = Telemetry(d)
+    tel.write_manifest({"model": "tiny", "strategy": "ddp", "world_size": 4,
+                        "global_batch": 64})
+    for i in range(1, 6):
+        tel.step(epoch=0, iter=i, loss=1.0 / i, step_time=0.01, steady=i > 2)
+    with open(os.path.join(d, "events.jsonl"), "a") as f:
+        f.write('{"kind": "step", "epoch": 0, "iter": 6, "los')  # torn tail
+    text = telemetry_report.render(d)
+    assert "!! 1 undecodable event line(s) skipped" in text
+    assert "5 (3 steady)" in text                  # good lines still counted
+
+
+# -- integration: the chaos matrix -------------------------------------------
+#
+# tiny_cnn on the 4-device CPU mesh, 7 batches of 64 with WINDOW=3 (windows
+# at 3/6, final batch through the absolute window grid).  Synthetic CIFAR-10
+# is deterministic, so one clean reference state serves every bitwise pin.
+
+LIMIT = 7
+
+_CLEAN_STATE = {}
+
+
+def _trainer(tmp_path, mesh4, *, ft=None, limit=LIMIT, log=None):
+    return Trainer(model=tiny_cnn(), strategy="allreduce", mesh=mesh4,
+                   global_batch=64, data_dir=str(tmp_path), augment=True,
+                   host_augment=True, limit_train_batches=limit,
+                   log=log or (lambda s: None), ft=ft)
+
+
+def _host_state(tr):
+    return jax.tree.map(lambda a: np.asarray(jax.device_get(a)), tr.state)
+
+
+def _clean_state(tmp_path, mesh4, limit=LIMIT):
+    assert looplib.WINDOW == 3, "callers must monkeypatch WINDOW first"
+    if limit not in _CLEAN_STATE:
+        tr = _trainer(tmp_path, mesh4, limit=limit)
+        tr.train_model(0)
+        _CLEAN_STATE[limit] = _host_state(tr)
+    return _CLEAN_STATE[limit]
+
+
+def _assert_bitwise(state_a, state_b):
+    la, lb = jax.tree.leaves(state_a), jax.tree.leaves(state_b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture
+def small_window(monkeypatch):
+    monkeypatch.setattr(looplib, "WINDOW", 3)
+
+
+def test_producer_crash_restart_is_bitwise(tmp_path, mesh4, small_window):
+    clean = _clean_state(tmp_path, mesh4)
+    plan = ChaosPlan.parse(["producer_crash:4"])
+    tr = _trainer(tmp_path, mesh4, ft=FTConfig(chaos=plan))
+    tr.train_model(0)
+    assert plan.fired == [("producer_crash", 4)]
+    assert tr.producer_failures == 1
+    assert tr.staging_degraded is False            # one restart sufficed
+    _assert_bitwise(_host_state(tr), clean)
+
+
+def test_producer_double_crash_degrades_bitwise(tmp_path, mesh4,
+                                                small_window):
+    clean = _clean_state(tmp_path, mesh4)
+    # The restarted producer hits the second entry at the same step: the
+    # restart budget (1) is exhausted and staging degrades to synchronous
+    # per-batch puts — overlap lost, stream unchanged.
+    plan = ChaosPlan.parse(["producer_crash:2", "producer_crash:2"])
+    lines = []
+    tr = _trainer(tmp_path, mesh4, ft=FTConfig(chaos=plan), log=lines.append)
+    tr.train_model(0)
+    assert tr.producer_failures == 2
+    assert tr.staging_degraded is True
+    assert any("degrading to synchronous" in ln for ln in lines)
+    _assert_bitwise(_host_state(tr), clean)
+
+
+def test_degraded_staging_mode_is_bitwise(tmp_path, mesh4, small_window):
+    clean = _clean_state(tmp_path, mesh4)
+    tr = _trainer(tmp_path, mesh4, ft=FTConfig(degrade_staging=True))
+    assert tr.staging_degraded is True
+    tr.train_model(0)
+    assert tr.producer_failures == 0
+    _assert_bitwise(_host_state(tr), clean)
+
+
+def test_corrupt_slot_detected_repaired_bitwise(tmp_path, mesh4,
+                                                small_window):
+    clean = _clean_state(tmp_path, mesh4)
+    plan = ChaosPlan.parse(["corrupt_slot:3"])
+    lines = []
+    tr = _trainer(tmp_path, mesh4, ft=FTConfig(chaos=plan), log=lines.append)
+    assert tr._verify_chunks is True               # auto-on with this site
+    tr.train_model(0)
+    assert ("corrupt_slot", 3) in plan.fired
+    assert any("staged batch 3 failed its checksum" in ln for ln in lines)
+    _assert_bitwise(_host_state(tr), clean)
+
+
+def test_put_fail_retried_bitwise(tmp_path, mesh4, small_window):
+    clean = _clean_state(tmp_path, mesh4)
+    plan = ChaosPlan.parse(["put_fail:2"])
+    lines = []
+    tr = _trainer(tmp_path, mesh4,
+                  ft=FTConfig(chaos=plan, backoff_base_s=0.001),
+                  log=lines.append)
+    tr.train_model(0)
+    assert ("put_fail", 2) in plan.fired
+    assert any("retrying with backoff" in ln for ln in lines)
+    assert tr.producer_failures == 0               # retry absorbed the fault
+    _assert_bitwise(_host_state(tr), clean)
+
+
+def test_put_delay_trips_watchdog_bitwise(tmp_path, mesh4, small_window):
+    clean = _clean_state(tmp_path, mesh4)
+    plan = ChaosPlan.parse(["put_delay:2"])
+    lines = []
+    tr = _trainer(tmp_path, mesh4,
+                  ft=FTConfig(chaos=plan, put_timeout_s=0.05),
+                  log=lines.append)
+    tr.train_model(0)
+    assert ("put_delay", 2) in plan.fired
+    # Detection-only: the watchdog logs the overrun, the put completes.
+    assert any("watchdog deadline" in ln for ln in lines)
+    _assert_bitwise(_host_state(tr), clean)
+
+
+def test_stall_deadline_raises_staging_stalled(tmp_path, mesh4):
+    tr = _trainer(tmp_path, mesh4, ft=FTConfig())
+
+    def wedged_fill(emit):
+        emit("first")
+        time.sleep(1.6)                            # producer alive but stuck
+
+    it = tr._prefetch_iter(wedged_fill, stall_timeout_s=0.1)
+    assert next(it) == "first"
+    with pytest.raises(StagingStalled, match="deadline"):
+        next(it)
+    it.close()
+
+
+# -- integration: non-finite step guard ---------------------------------------
+
+def test_nonfinite_skip_counts_and_keeps_params_finite(tmp_path, mesh4,
+                                                       small_window):
+    plan = ChaosPlan.parse(["nonfinite_grad:2"])
+    tr = _trainer(tmp_path, mesh4,
+                  ft=FTConfig(nonfinite="skip", chaos=plan))
+    timers = tr.train_model(0)
+    assert ("nonfinite_grad", 2) in plan.fired
+    assert tr.nonfinite_skipped == 1
+    assert tr.nonfinite_restored == 0
+    assert np.isfinite(timers.losses).all()        # bad update never applied
+    for leaf in jax.tree.leaves(_host_state(tr)):
+        assert np.isfinite(leaf).all()
+
+
+def test_nonfinite_halt_raises_before_applying(tmp_path, mesh4,
+                                               small_window):
+    tr = _trainer(tmp_path, mesh4,
+                  ft=FTConfig(nonfinite="halt",
+                              chaos=ChaosPlan.parse(["nonfinite_grad:2"])))
+    with pytest.raises(NonFiniteError, match="policy=halt"):
+        tr.train_model(0)
+
+
+def test_nonfinite_restore_rolls_back_and_continues(tmp_path, mesh4,
+                                                    small_window):
+    plan = ChaosPlan.parse(["nonfinite_grad:2"])
+    lines = []
+    tr = _trainer(tmp_path, mesh4,
+                  ft=FTConfig(nonfinite="restore", chaos=plan),
+                  log=lines.append)
+    tr.train_model(0)
+    assert tr.nonfinite_restored == 1
+    assert any("rolled back" in ln for ln in lines)
+    for leaf in jax.tree.leaves(_host_state(tr)):
+        assert np.isfinite(leaf).all()
+
+
+# -- integration: preemption-safe mid-epoch resume ----------------------------
+
+def test_chaos_preempt_without_checkpoint_dir_raises(tmp_path, mesh4,
+                                                     small_window):
+    tr = _trainer(tmp_path, mesh4,
+                  ft=FTConfig(chaos=ChaosPlan.parse(["preempt:0"])))
+    with pytest.raises(RuntimeError, match="chaos preempt requires"):
+        tr.train_model(0)                          # no guard installed
+
+
+def test_chaos_preempt_mid_epoch_resume_is_bitwise(tmp_path, mesh4,
+                                                   small_window):
+    """The tentpole pin: SIGTERM at a step boundary -> emergency mid-epoch
+    checkpoint -> a fresh process-equivalent Trainer resumes from that
+    exact step -> the finished epoch is bitwise identical to one that was
+    never interrupted."""
+    ck = str(tmp_path / "ck")
+    lines = []
+
+    def small_eval(tr):
+        tr.test_split = cifar10.Split(tr.test_split.images[:64],
+                                      tr.test_split.labels[:64])
+        return tr
+
+    # Interrupted run: injected SIGTERM once progress reaches step 5 —
+    # the boundary poll sees it at trained=6 (WINDOW=3 grid).
+    tr1 = small_eval(_trainer(
+        tmp_path, mesh4, log=lines.append,
+        ft=FTConfig(chaos=ChaosPlan.parse(["preempt:5"]))))
+    tr1.run(1, checkpoint_dir=ck)
+    assert tr1.preempted is True
+    assert any("emergency checkpoint saved" in ln for ln in lines)
+
+    peek = CheckpointManager(ck)
+    assert peek.latest_mid_epoch() == (0, 6)
+    assert peek.latest_epoch() is None
+    peek.close()
+
+    # Resume (no chaos): picks up at epoch 0 step 6, finishes the epoch.
+    tr2 = small_eval(_trainer(tmp_path, mesh4, log=lines.append))
+    tr2.run(1, checkpoint_dir=ck)
+    assert tr2.preempted is False
+    assert any("Resumed from mid-epoch checkpoint: epoch 0, step 6" in ln
+               for ln in lines)
+
+    # Uninterrupted reference with the same program configuration.
+    tr0 = small_eval(_trainer(tmp_path, mesh4))
+    tr0.run(1)
+    _assert_bitwise(_host_state(tr2), _host_state(tr0))
+
+    # The completed epoch checkpoint outranks — and clears — the mid-epoch
+    # emergency save (a later run must not rewind into the epoch).
+    peek = CheckpointManager(ck)
+    assert peek.latest_epoch() == 0
+    assert peek.latest_mid_epoch() is None
+    peek.close()
+
+
+CHILD_SCRIPT = """\
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import sys
+repo, tests_dir, ck, data = sys.argv[1:5]
+sys.path.insert(0, repo)
+sys.path.insert(0, tests_dir)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cs744_ddp_tpu.utils.compcache import enable_persistent_compilation_cache
+enable_persistent_compilation_cache(repo)
+import cs744_ddp_tpu.train.loop as looplib
+looplib.WINDOW = 3
+from cs744_ddp_tpu.data import cifar10
+from cs744_ddp_tpu.parallel import make_mesh
+from tinynet import tiny_cnn
+tr = looplib.Trainer(model=tiny_cnn(), strategy="allreduce",
+                     mesh=make_mesh(4), global_batch=64, data_dir=data,
+                     augment=True, host_augment=True, limit_train_batches=45,
+                     log=lambda s: print(s, flush=True))
+tr.test_split = cifar10.Split(tr.test_split.images[:64],
+                              tr.test_split.labels[:64])
+tr.run(1, checkpoint_dir=ck)
+print("CHILD_PREEMPTED" if tr.preempted else "CHILD_COMPLETED", flush=True)
+"""
+
+
+def test_sigterm_subprocess_emergency_checkpoint_and_resume(
+        tmp_path, mesh4, small_window):
+    """End-to-end preemption exactly as a pod scheduler delivers it: a REAL
+    SIGTERM to a separate training process mid-epoch.  The child finishes
+    its in-flight step, writes the emergency checkpoint and exits cleanly;
+    resuming from its checkpoint dir completes the epoch bitwise identical
+    to a never-interrupted run."""
+    ck = str(tmp_path / "ck")
+    script = tmp_path / "child.py"
+    script.write_text(CHILD_SCRIPT)
+    proc = subprocess.Popen(
+        [sys.executable, str(script), REPO, os.path.dirname(__file__),
+         ck, str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    reaper = threading.Timer(420, proc.kill)       # hang backstop only
+    reaper.start()
+    signaled = False
+    lines = []
+    try:
+        for line in proc.stdout:
+            lines.append(line)
+            if not signaled and "Training loss after 20 iterations" in line:
+                proc.send_signal(signal.SIGTERM)   # mid-epoch, mid-training
+                signaled = True
+        proc.wait(timeout=120)
+    finally:
+        reaper.cancel()
+    out = "".join(lines)
+    assert signaled, f"child never reached iteration 20:\n{out}"
+    assert proc.returncode == 0, out               # clean exit, not a kill
+    assert "emergency checkpoint saved" in out
+    assert "CHILD_PREEMPTED" in out
+
+    peek = CheckpointManager(ck)
+    mid = peek.latest_mid_epoch()
+    peek.close()
+    assert mid is not None and mid[0] == 0 and 20 < mid[1] <= 45
+
+    def small_eval(tr):
+        tr.test_split = cifar10.Split(tr.test_split.images[:64],
+                                      tr.test_split.labels[:64])
+        return tr
+
+    lines2 = []
+    tr2 = small_eval(_trainer(tmp_path, mesh4, limit=45, log=lines2.append))
+    tr2.run(1, checkpoint_dir=ck)
+    assert any("Resumed from mid-epoch checkpoint" in ln for ln in lines2)
+
+    tr0 = small_eval(_trainer(tmp_path, mesh4, limit=45))
+    tr0.run(1)
+    _assert_bitwise(_host_state(tr2), _host_state(tr0))

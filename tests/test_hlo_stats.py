@@ -94,6 +94,49 @@ def test_collective_chain_depth_on_handcrafted_module():
     assert collective_chain_depth(DEPTH_SAMPLE) == 4
 
 
+def test_collective_chain_depth_chain_feeding_collective_callee():
+    from cs744_ddp_tpu.utils.hlo_stats import collective_chain_depth
+    # A collective chain FEEDING a collective-bearing called computation:
+    # ar1's result is the while's operand, and the while body runs its own
+    # all-reduce, so the body's collective necessarily executes AFTER ar1 —
+    # operand chain and callee internals compose to depth 1 + 1 = 2.
+    # (Taking max(operand_chain, callee_depth) instead of their sum reads
+    # this module as depth 1 — the undercount this fixture pins against.)
+    txt = """\
+region_add.1 {
+  lhs = f32[] parameter(0)
+  rhs = f32[] parameter(1)
+  ROOT add.r = f32[] add(lhs, rhs)
+}
+
+cond.1 {
+  cp = f32[8]{0} parameter(0)
+  ROOT lt = pred[] constant(false)
+}
+
+body.1 {
+  bp = f32[8]{0} parameter(0)
+  ar.body = f32[8]{0} all-reduce(bp), to_apply=region_add.1
+  ROOT bt = f32[8]{0} add(ar.body, ar.body)
+}
+
+ENTRY main.1 {
+  p0 = f32[8]{0} parameter(0)
+  ar1 = f32[8]{0} all-reduce(p0), to_apply=region_add.1
+  w = f32[8]{0} while(ar1), body=body.1, condition=cond.1
+  ROOT r = f32[8]{0} add(w, w)
+}
+"""
+    assert collective_chain_depth(txt) == 2
+    # Lengthening the feeding chain must lengthen the total the same way:
+    # ar1 -> ar2 -> while(collective body) = 3.
+    txt3 = txt.replace(
+        "  w = f32[8]{0} while(ar1), body=body.1, condition=cond.1",
+        "  ar2 = f32[8]{0} all-reduce(ar1), to_apply=region_add.1\n"
+        "  w = f32[8]{0} while(ar2), body=body.1, condition=cond.1")
+    assert collective_chain_depth(txt3) == 3
+
+
 def test_collective_chain_depth_async_pairs_count_once():
     from cs744_ddp_tpu.utils.hlo_stats import collective_chain_depth
     txt = """\

@@ -18,6 +18,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from cs744_ddp_tpu.obs import read_run, summarize_events  # noqa: E402
+from cs744_ddp_tpu.obs.telemetry import read_events_jsonl  # noqa: E402
 
 
 def _fmt_ms(seconds: float) -> str:
@@ -26,12 +27,22 @@ def _fmt_ms(seconds: float) -> str:
 
 def render(out_dir: str) -> str:
     manifest, events, summary = read_run(out_dir)
+    # A preempted/killed run legitimately truncates the final event line;
+    # count and surface it rather than failing the report (the report may
+    # be the only diagnostic artifact such a run leaves).
+    _, n_bad = read_events_jsonl(
+        os.path.join(out_dir, "events.jsonl"),
+        warn=lambda msg: print(f"warning: {msg}", file=sys.stderr))
     if summary is None:
         # Interrupted run: recompute from the raw events so a partial run
         # still renders (the report may be the only diagnostic artifact).
         gb = (manifest or {}).get("global_batch")
         summary = summarize_events(events, global_batch=gb)
     lines = [f"telemetry run: {out_dir}", ""]
+    if n_bad:
+        lines.append(f"  !! {n_bad} undecodable event line(s) skipped "
+                     f"(run killed mid-write?)")
+        lines.append("")
 
     if manifest:
         lines.append("== run manifest ==")
