@@ -34,8 +34,9 @@ tests pin both.  The full payload carries
   * ``convergence`` — the reference's correctness oracle (test accuracy,
     ``Part 1/main.py:74-76``) as a per-epoch TRAJECTORY over 3 epochs at
     the reference config, plus a ``stable_lr`` companion entry (1 epoch
-    at lr 0.01 — the reference lr collapses big models on the synthetic
-    stand-in; see BASELINE.md), labeled ``real_data`` false when the
+    at lr 0.01 — a faster-learning control the CI floor rides on; see
+    BASELINE.md "Synthetic-task recalibration (round 7)" for the graded
+    trajectory the stand-in now shows), labeled ``real_data`` false when the
     synthetic fallback is in use (this host has no egress), and
   * ``spectrum`` — static per-strategy collective counts, comm bytes and
     dependency-chain depths from the TPU v5e-8 AOT lowering (the strategy
@@ -52,7 +53,16 @@ tests pin both.  The full payload carries
     degraded synchronous staging fallback as a fraction of the healthy
     chunked pipeline, emergency mid-epoch checkpoint save/restore wall
     clock with the steps-lost accounting, and a deterministic
-    chaos-injected NaN-skip demo.
+    chaos-injected NaN-skip demo, and
+  * ``serving`` — the inference fast path (``run_serving``,
+    ``cs744_ddp_tpu/serve/``): throughput-vs-bucket curve over the AOT
+    executable ladder (per-dispatch fenced latency AND the amortized
+    device-program time — on the tunneled TPU host the two differ by the
+    ~100 ms dispatch tax, see BASELINE.md), client-side latency
+    p50/p95/p99 under a seeded open-loop arrival trace at 2-3 offered
+    loads, and COLD vs WARM startup seconds measured in fresh
+    subprocesses sharing one executable-cache dir (the warm-start
+    acceptance bar: warm < 0.5 x cold).
 
 Protocol (BASELINE.md): the reference's own measurement design — windowed
 wall-clock fenced by fetching the loss values, the first window (compile +
@@ -533,11 +543,185 @@ def run_robustness(log, *, headline_model: str = "vgg11",
     return out
 
 
+def _startup_cold_warm(log, *, model: str, buckets, seed: int,
+                       timeout_s: float = 900.0) -> dict:
+    """COLD vs WARM engine startup, each measured in a FRESH subprocess
+    (``python -m cs744_ddp_tpu.serve.demo --startup-probe``) sharing one
+    executable-cache dir: run 1 populates it (cold), run 2 loads from it
+    (warm).  Subprocesses because in-process \"restarts\" inherit jax's
+    in-memory jit caches and would overstate the warm win.
+
+    Falls back to in-process measurement (two engines, fresh cache dir)
+    when the subprocess path is unavailable — e.g. a test-registered model
+    the child interpreter has never heard of — and labels the result's
+    ``method`` accordingly.  Note the repo-wide persistent XLA cache stays
+    active in BOTH runs (it is process-global state, exactly what a server
+    restart on this host would see), so \"cold\" means \"no serialized
+    executables\", not \"no compile cache\" — ``cold_includes_xla_cache``
+    records this."""
+    import subprocess
+    import tempfile
+
+    bucket_spec = ",".join(str(b) for b in buckets)
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def _probe(cache_dir: str):
+        cmd = [sys.executable, "-m", "cs744_ddp_tpu.serve.demo",
+               "--startup-probe", "--model", model,
+               "--buckets", bucket_spec, "--cache-dir", cache_dir,
+               "--seed", str(seed)]
+        proc = subprocess.run(cmd, cwd=repo, capture_output=True,
+                              text=True, timeout=timeout_s)
+        if proc.returncode != 0:
+            return None, proc.stderr.strip().splitlines()[-1:] or ["?"]
+        return json.loads(proc.stdout.strip().splitlines()[-1]), None
+
+    with tempfile.TemporaryDirectory() as cache_dir:
+        log(f"[bench] serving: cold startup probe ({model}, subprocess)")
+        cold, err = _probe(cache_dir)
+        if cold is not None:
+            log("[bench] serving: warm startup probe (same cache dir)")
+            warm, err = _probe(cache_dir)
+        if cold is None or warm is None:
+            # Child interpreter can't build this model (or died): measure
+            # in-process — still two engine builds against one cache dir.
+            log(f"[bench] serving: subprocess probe unavailable "
+                f"({err}); measuring startup in-process")
+            from cs744_ddp_tpu.serve import InferenceEngine
+            cold = InferenceEngine(model, buckets=buckets, seed=seed,
+                                   cache_dir=cache_dir).startup()
+            warm = InferenceEngine(model, buckets=buckets, seed=seed,
+                                   cache_dir=cache_dir).startup()
+            method = "in_process"
+        else:
+            method = "subprocess"
+    out = {
+        "method": method,
+        "cold_s": cold["startup_s"],
+        "warm_s": warm["startup_s"],
+        "warm_was_all_cache": warm["warm"],
+        "warm_lt_half_cold": warm["startup_s"] < 0.5 * cold["startup_s"],
+        "cold_includes_xla_cache": True,
+        "executable_serialization": cold["executable_cache"]["supported"],
+        "cold_per_bucket": cold["per_bucket"],
+        "warm_per_bucket": warm["per_bucket"],
+    }
+    if not out["warm_lt_half_cold"]:
+        log(f"[bench] serving: WARNING warm startup {out['warm_s']}s is "
+            f"not < 0.5 x cold {out['cold_s']}s")
+    return out
+
+
+def run_serving(log, *, model: str = "vgg11", buckets=None,
+                loads=(5.0, 20.0), n_requests: int = 100,
+                max_wait_ms: float = 5.0, seed: int = 0,
+                dispatch_reps: int = 20, dispatch_budget_s: float = 3.0,
+                precision: str = "f32", startup_probe: bool = True) -> dict:
+    """The serving fast path's numbers (``cs744_ddp_tpu/serve/``), measured:
+
+    * ``throughput_vs_bucket`` — for every rung of the executable ladder:
+      ``per_dispatch_ms`` (one FENCED ``infer_counts`` call: staging +
+      dispatch + logits fetch — what a lone request experiences) and
+      ``device_program_ms`` (back-to-back enqueues on the same staged
+      buffer, blocked once at the end, divided by the rep count — the
+      device program's amortized cost with dispatch overhead overlapped).
+      The spread between the two IS the per-dispatch tax (~100 ms on the
+      tunneled TPU host, BASELINE.md); ``images_per_sec`` uses the
+      amortized figure, the saturated-pipeline ceiling.
+    * ``latency`` — client-side p50/p95/p99 under a seeded OPEN-LOOP
+      arrival trace through the bounded-queue micro-batcher, one entry per
+      offered load (requests/sec) — the knee where queueing delay takes
+      over is the capacity statement.
+    * ``startup`` — cold vs warm engine startup (``_startup_cold_warm``):
+      the executable ladder compiled from scratch vs deserialized from the
+      warm-start cache, fresh subprocess each.
+
+    Standalone-callable, same contract as ``run_robustness``: the
+    committed artifact's serving section can be refreshed without
+    re-running the training-side sections."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from cs744_ddp_tpu.obs import Telemetry
+    from cs744_ddp_tpu.serve import BUCKETS, InferenceEngine
+    from cs744_ddp_tpu.serve.demo import request_pool, run_demo
+
+    log = log or (lambda s: print(s, file=sys.stderr))
+    buckets = tuple(buckets) if buckets else BUCKETS
+    tel = Telemetry()   # in-memory; summary attached below
+    log(f"[bench] serving: building {model} ladder over buckets "
+        f"{buckets} ({precision})")
+    engine = InferenceEngine(model, buckets=buckets, seed=seed,
+                             precisions=(precision,), telemetry=tel)
+    ladder = engine.startup()
+    out = {
+        "backend": jax.default_backend(),
+        "model": model,
+        "buckets": list(buckets),
+        "precision": precision,
+        "ladder_startup": ladder,
+    }
+
+    # Throughput-vs-bucket curve.  The rep count adapts to the measured
+    # per-dispatch time so a slow rung (vgg11/256 on a 1-core CPU host)
+    # costs ~dispatch_budget_s, not dispatch_reps x seconds.
+    pool = request_pool(max(buckets), seed=seed + 7)
+    curve = {}
+    for b in buckets:
+        images = pool.images[:b]
+        labels = pool.labels[:b]
+        engine.infer_counts(images, labels, precision=precision)  # warm
+        per_disp = float("inf")
+        for _ in range(3):
+            t0 = _time.time()
+            engine.infer_counts(images, labels, precision=precision)
+            per_disp = min(per_disp, _time.time() - t0)
+        reps = max(3, min(dispatch_reps, int(dispatch_budget_s / per_disp)
+                          if per_disp > 0 else dispatch_reps))
+        ex = engine._executable(b, precision)
+        staged = engine._pad_stage(images, b)
+        padded_labels = np.asarray(labels, np.int32)
+        res = ex(engine.params, engine.bn_state, staged, padded_labels)
+        jax.block_until_ready(res)
+        t0 = _time.time()
+        for _ in range(reps):
+            res = ex(engine.params, engine.bn_state, staged, padded_labels)
+        jax.block_until_ready(res)
+        prog = (_time.time() - t0) / reps
+        curve[str(b)] = {
+            "per_dispatch_ms": round(per_disp * 1e3, 3),
+            "device_program_ms": round(prog * 1e3, 3),
+            "images_per_sec": round(b / prog, 2),
+            "reps": reps,
+        }
+        log(f"[bench] serving: bucket {b}: {curve[str(b)]['images_per_sec']}"
+            f" img/s amortized, {curve[str(b)]['per_dispatch_ms']} ms/dispatch")
+    out["throughput_vs_bucket"] = curve
+
+    # Open-loop latency at the offered loads (seeded trace, shared pool).
+    out["latency"] = {}
+    for rps in loads:
+        log(f"[bench] serving: open-loop trace at {rps:g} req/s "
+            f"({n_requests} requests)")
+        out["latency"][f"{rps:g}rps"] = run_demo(
+            engine, n_requests=n_requests, offered_rps=rps, seed=seed,
+            max_wait_ms=max_wait_ms, pool=pool, precision=precision)
+
+    if startup_probe:
+        out["startup"] = _startup_cold_warm(log, model=model,
+                                            buckets=buckets, seed=seed)
+    out["telemetry_summary"] = tel.finalize()
+    return out
+
+
 def run_bench(*, matrix: bool = True, sweep: bool = True,
               peak: bool = True, convergence: bool = True,
               convergence_epochs: int = 3,
               spectrum: bool = True, host_pipeline: bool = True,
-              robustness: bool = True,
+              robustness: bool = True, serving: bool = True,
+              serving_kwargs=None,
               max_iters: int = 100,
               global_batch: int = 256,
               models=MODELS, strategies=STRATEGIES, deep_rows=DEEP_ROWS,
@@ -593,10 +777,11 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
     # ``convergence_epochs``; a half-broken step can luck into one
     # above-chance epoch, not a rising multi-epoch trend — VERDICT r4
     # item 3).  On this egress-less bench host the dataset is the
-    # deterministic synthetic fallback (real_data=false, labels derived
-    # from image statistics — learnable, so accuracy moves well above the
-    # 10% chance floor); real-CIFAR accuracy remains unverifiable here
-    # (BASELINE.md).
+    # deterministic synthetic fallback (real_data=false; class-templated
+    # noisy images, recalibrated round 7 so the reference config learns
+    # GRADUALLY — rising epoch over epoch, between the 10% chance floor
+    # and the label-noise ceiling); real-CIFAR accuracy remains
+    # unverifiable here (BASELINE.md).
     if convergence:
         log(f"[bench] convergence: {headline_model}/{headline_strategy}, "
             f"{convergence_epochs} epochs @ reference config")
@@ -632,13 +817,15 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
             "telemetry_summary": conv_tel.finalize(
                 global_batch=global_batch),
         }
-        # Companion entry at a stable lr: the reference's lr=0.1 is tuned
-        # for real CIFAR-10 and COLLAPSES the big models on the synthetic
-        # stand-in (VGG-11 probe: accuracy frozen at exactly 19.7% for 8
-        # epochs, loss asymptote ~2.0 — a degenerate minimum, measured
-        # round 5), which would read as a broken trainer.  lr=0.01 shows
-        # the framework's actual convergence behavior on the same data
-        # (VGG-11: 100% test accuracy after ONE epoch).
+        # Companion entry at a stable lr: a faster-learning 1-epoch control
+        # next to the reference-lr trajectory.  On the ROUND-7 recalibrated
+        # synthetic task (data/cifar10.py knob comments) the reference
+        # lr=0.1 no longer collapses the net — it climbs epoch over epoch
+        # (tiny @ 12.8k imgs: 16% -> 32% -> 35%) — but it starts slow, so
+        # the CI learning floor rides on this lr=0.01 entry, which clears
+        # chance decisively within one epoch (tiny @ 12.8k imgs: 50%).
+        # Round-5 history (single-template task: lr 0.1 froze VGG-11 at
+        # 19.7%, lr 0.01 hit 100% in one epoch) is preserved in BASELINE.md.
         from cs744_ddp_tpu.ops import sgd as _sgd
         stable_cfg = _sgd.SGDConfig(lr=0.01)
         log(f"[bench] convergence: {headline_model}/{headline_strategy}, "
@@ -837,6 +1024,12 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
             global_batch=global_batch, data_dir=data_dir,
             max_iters=max_iters)
 
+    # Serving fast path: ladder throughput curve, open-loop latency,
+    # cold/warm startup (cs744_ddp_tpu/serve/).
+    if serving:
+        result["serving"] = run_serving(log, model=headline_model,
+                                        **(serving_kwargs or {}))
+
     if sweep:
         # WEAK scaling: per-chip batch held at ``global_batch`` while the
         # mesh grows (global = global_batch x n).  The north star is
@@ -987,6 +1180,10 @@ def main(argv=None) -> None:
                    help="skip the fault-tolerance cost/benefit section "
                         "(guard overhead, degraded staging, emergency "
                         "checkpoint timing, skip-policy demo)")
+    p.add_argument("--no-serving", action="store_true",
+                   help="skip the serving fast-path section (bucket "
+                        "throughput curve, open-loop latency, cold/warm "
+                        "startup)")
     p.add_argument("--max-iters", type=int, default=100,
                    help="minimum steady-state iterations per config")
     p.add_argument("--global-batch", type=int, default=256)
@@ -1023,6 +1220,7 @@ def main(argv=None) -> None:
                                           or args.no_matrix),
                        robustness=not (args.no_robustness
                                        or args.no_matrix),
+                       serving=not (args.no_serving or args.no_matrix),
                        max_iters=args.max_iters,
                        global_batch=args.global_batch)
     emit_result(result, args.full_out or os.path.join(

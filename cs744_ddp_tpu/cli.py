@@ -20,6 +20,7 @@ import argparse
 
 from .ft import FTConfig, ChaosPlan, guard as ftguard
 from .obs import NULL, Telemetry
+from .utils import compcache
 from .ops import sgd
 from .parallel import mesh as meshlib
 from .train.loop import GLOBAL_BATCH, Trainer
@@ -41,8 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["single", "gather", "allreduce", "ddp"],
                    help="gradient sync strategy: Part 1/2a/2b/3 equivalents")
     p.add_argument("--model", default="vgg11",
-                   choices=["vgg11", "vgg13", "vgg16", "vgg19",
-                            "resnet18", "resnet34"])
+                   help="vgg11/13/16/19, resnet18/34, or any name "
+                        "registered via models.register_model (validated "
+                        "by the model zoo, not argparse, so plugged-in "
+                        "models work everywhere the built-ins do)")
     p.add_argument("--batch-size", type=int, default=GLOBAL_BATCH,
                    help="GLOBAL batch (divided across workers, as in the "
                         "reference: Part 2a/main.py:22)")
@@ -132,6 +135,37 @@ def build_parser() -> argparse.ArgumentParser:
                     help="checksum every staged batch at fill time and "
                          "re-stage any row whose bytes changed by transfer "
                          "time (auto-enabled by corrupt_slot chaos)")
+    sv = p.add_argument_group(
+        "serving (serve/)",
+        "single-chip inference: AOT bucket ladder + micro-batching + "
+        "warm-start executable cache; --serve-demo replays a seeded "
+        "open-loop request trace and prints the stats sheet as one JSON "
+        "line instead of training")
+    sv.add_argument("--serve-demo", action="store_true",
+                    help="serve mode: build the executable ladder for "
+                         "--model, replay the seeded synthetic request "
+                         "trace at each --serve-load, print startup + "
+                         "latency/throughput JSON")
+    sv.add_argument("--serve-buckets", default="1,8,32,128,256",
+                    help="comma list of batch buckets for the AOT ladder")
+    sv.add_argument("--serve-precision", default="f32",
+                    choices=["f32", "bf16"])
+    sv.add_argument("--serve-requests", type=int, default=200,
+                    help="requests per offered-load replay")
+    sv.add_argument("--serve-load", action="append", type=float,
+                    default=None, metavar="RPS",
+                    help="offered load in requests/sec (repeatable; "
+                         "default one replay at 20 rps)")
+    sv.add_argument("--serve-max-wait-ms", type=float, default=5.0,
+                    help="micro-batcher deadline: max time the oldest "
+                         "queued request waits before dispatch")
+    sv.add_argument("--serve-cache-dir", default=None,
+                    help="warm-start executable cache directory (a "
+                         "restarted server loads serialized executables "
+                         "instead of compiling)")
+    sv.add_argument("--serve-seed", type=int, default=0,
+                    help="seed for the synthetic request trace AND the "
+                         "demo model init")
     return p
 
 
@@ -154,8 +188,41 @@ def ft_config_from_args(args) -> "FTConfig | None":
     )
 
 
+def serve_main(args, telemetry) -> None:
+    """--serve-demo: build the ladder, replay the seeded trace at each
+    offered load, print ONE JSON line (startup report + per-load stats)."""
+    import json
+
+    from .serve import InferenceEngine, demo
+
+    buckets = demo.parse_buckets(args.serve_buckets)
+    engine = InferenceEngine(
+        args.model, buckets=buckets, precisions=(args.serve_precision,),
+        cache_dir=args.serve_cache_dir, seed=args.serve_seed,
+        telemetry=telemetry)
+    telemetry.write_manifest({
+        "mode": "serve", "model": args.model, "buckets": list(buckets),
+        "precision": args.serve_precision,
+        "max_wait_ms": args.serve_max_wait_ms,
+        "requests": args.serve_requests, "seed": args.serve_seed,
+    })
+    startup = engine.startup()
+    loads = args.serve_load or [20.0]
+    stats = {}
+    for rps in loads:
+        stats[f"{rps:g}rps"] = demo.run_demo(
+            engine, n_requests=args.serve_requests, offered_rps=rps,
+            seed=args.serve_seed, max_wait_ms=args.serve_max_wait_ms,
+            precision=args.serve_precision)
+    print(json.dumps({"startup": startup, "demo": stats}))
+
+
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    # Persistent XLA compilation cache, unconditionally (previously only
+    # bench/tests opted in): repeated CLI runs of the same config skip
+    # multi-second XLA compiles; hit/miss counts land in the manifest.
+    compcache.enable_persistent_compilation_cache(compcache.repo_root())
     if args.require_real_data:
         from .data import cifar10
         if not cifar10.has_real_data(args.data_dir):
@@ -168,6 +235,14 @@ def main(argv=None) -> None:
                                    port=args.port)
     telemetry = (Telemetry(args.telemetry_out)
                  if args.telemetry_out is not None else NULL)
+    if args.serve_demo:
+        try:
+            serve_main(args, telemetry)
+        finally:
+            telemetry.update_manifest(
+                {"compilation_cache": compcache.cache_stats()})
+            telemetry.finalize()
+        return
     trainer = Trainer(
         model=args.model,
         strategy=args.strategy,
@@ -190,7 +265,11 @@ def main(argv=None) -> None:
                     profile_dir=args.profile_dir)
     finally:
         # summary.json even on an interrupted run — partial runs are the
-        # ones whose artifact is most needed.
+        # ones whose artifact is most needed.  Cache hit/miss tallies are
+        # only final once every compile has happened, hence manifest
+        # UPDATE here rather than a field at construction.
+        telemetry.update_manifest(
+            {"compilation_cache": compcache.cache_stats()})
         telemetry.finalize(global_batch=args.batch_size)
 
 

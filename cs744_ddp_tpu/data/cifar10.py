@@ -49,27 +49,66 @@ def _load_pickle_batches(batch_dir: str, names) -> Split:
     return Split(np.concatenate(imgs), np.concatenate(labs))
 
 
+# Synthetic-task difficulty knobs, recalibrated (round 7) so the REFERENCE
+# config (VGG-11, lr 0.1) shows a GRADED multi-epoch trajectory on the
+# stand-in — neither the frozen-at-19.7% collapse round 5 measured (the old
+# single-template/low-noise task pushed the first lr-0.1 step so far the net
+# died at ln(10) loss) nor instant 100% (one epoch used to saturate, making
+# a 3-epoch trajectory uninformative).  See BASELINE.md "Synthetic-task
+# recalibration (round 7)" for the measured before/after trajectories.
+_TEMPLATES_PER_CLASS = 3   # intra-class variety: one template is memorizable
+_NOISE = 0.7               # per-pixel uniform noise fraction of the mix
+_SHARED = 0.55             # inter-class template correlation (harder margins)
+_CONTRAST = 0.5            # post-mix contrast toward mid-gray: shrinks the
+#                            normalized input scale, which is THE knob that
+#                            keeps the first lr-0.1 step from killing the
+#                            net (measured on the CI tiny model: contrast
+#                            1.0 -> frozen at exactly ln(10) loss even at
+#                            full 50k scale; 0.5 -> stable graded learning)
+_LABEL_NOISE = 0.1         # fraction of labels resampled uniformly: caps
+#                            attainable accuracy below saturation
+
+
 def _class_templates() -> np.ndarray:
-    """Fixed low-frequency per-class templates, shared by BOTH splits (so a
-    model trained on the train split generalizes to the test split)."""
+    """Fixed low-frequency templates, shared by BOTH splits (so a model
+    trained on the train split generalizes to the test split).
+
+    [NUM_CLASSES, _TEMPLATES_PER_CLASS, 32, 32, 3]: every template is a
+    blend of one GLOBAL base pattern (weight ``_SHARED`` — inter-class
+    correlation, so classes are not linearly-separable blobs far apart),
+    a per-class pattern, and a per-template variant (intra-class variety)."""
     rng = np.random.default_rng(42)
-    small = rng.uniform(40, 215, size=(NUM_CLASSES, 4, 4, 3)).astype(np.float32)
-    return np.repeat(np.repeat(small, 8, axis=1), 8, axis=2)
+    base = rng.uniform(40, 215, size=(1, 1, 4, 4, 3)).astype(np.float32)
+    cls = rng.uniform(40, 215,
+                      size=(NUM_CLASSES, 1, 4, 4, 3)).astype(np.float32)
+    var = rng.uniform(40, 215,
+                      size=(NUM_CLASSES, _TEMPLATES_PER_CLASS, 4, 4, 3)
+                      ).astype(np.float32)
+    small = _SHARED * base + (1 - _SHARED) * (0.65 * cls + 0.35 * var)
+    return np.repeat(np.repeat(small, 8, axis=2), 8, axis=3)
 
 
 def _synthetic_split(n: int, seed: int) -> Split:
-    """Class-templated noisy images: trivially learnable, fully deterministic.
+    """Class-templated noisy images: deterministic, learnable, NOT trivial.
 
-    Each class c gets a fixed low-frequency template (shared across splits);
-    a sample is 0.75*template + 0.25*noise quantized to uint8 — enough signal
-    that a CNN's loss drops fast (the convergence oracle of SURVEY.md §4),
-    enough noise that it is not memorizable from one example.
-    """
+    A sample draws one of its class's templates, mixes in ``_NOISE``
+    uniform noise, pulls the result toward mid-gray by ``_CONTRAST``, and
+    with probability ``_LABEL_NOISE`` carries a uniformly-resampled label.
+    Calibrated (see knob comments above) so reference-config training
+    rises epoch over epoch while staying between the 10% chance floor and
+    saturation — the shape a convergence ORACLE needs to detect both a
+    broken step (stuck at chance) and a degenerate task (instant 100%)."""
     rng = np.random.default_rng(seed)
     templates = _class_templates()
     labels = rng.integers(0, NUM_CLASSES, size=n).astype(np.int32)
+    tidx = rng.integers(0, _TEMPLATES_PER_CLASS, size=n)
     noise = rng.uniform(0, 255, size=(n, 32, 32, 3)).astype(np.float32)
-    images = 0.75 * templates[labels] + 0.25 * noise
+    images = (1 - _NOISE) * templates[labels, tidx] + _NOISE * noise
+    images = 127.5 + _CONTRAST * (images - 127.5)
+    if _LABEL_NOISE:
+        flip = rng.random(n) < _LABEL_NOISE
+        labels = np.where(flip, rng.integers(0, NUM_CLASSES, size=n),
+                          labels).astype(np.int32)
     return Split(np.clip(images, 0, 255).astype(np.uint8), labels)
 
 

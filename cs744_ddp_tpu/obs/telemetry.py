@@ -147,7 +147,13 @@ class NullTelemetry:
     def span(self, name: str, **attrs):
         return _NULL_SPAN
 
+    def counter_totals(self) -> Dict[str, float]:
+        return {}
+
     def write_manifest(self, fields: Dict[str, Any]) -> None:
+        pass
+
+    def update_manifest(self, fields: Dict[str, Any]) -> None:
         pass
 
     def finalize(self, **extra) -> Optional[Dict[str, Any]]:
@@ -262,10 +268,28 @@ class Telemetry:
     def span(self, name: str, **attrs) -> _Span:
         return _Span(self, name, attrs)
 
+    def counter_totals(self) -> Dict[str, float]:
+        """Current counter totals (a copy) without draining the event log
+        — live introspection for the serving demo's bucket histogram."""
+        with self._lock:
+            return dict(self._counters)
+
     # -- run header / footer -------------------------------------------------
 
     def write_manifest(self, fields: Dict[str, Any]) -> None:
         man = {"schema_version": _SCHEMA_VERSION, "created_at": time.time()}
+        man.update(fields)
+        self.manifest = man
+        if self.out_dir is not None:
+            atomic_write_json(os.path.join(self.out_dir, "manifest.json"),
+                              man)
+
+    def update_manifest(self, fields: Dict[str, Any]) -> None:
+        """Merge ``fields`` into the manifest and rewrite it — for facts
+        only known at the END of a run (compilation-cache hit/miss
+        counts) joining a header written at construction."""
+        man = dict(self.manifest) if self.manifest else \
+            {"schema_version": _SCHEMA_VERSION, "created_at": time.time()}
         man.update(fields)
         self.manifest = man
         if self.out_dir is not None:
@@ -316,6 +340,10 @@ def summarize_events(events: List[Dict[str, Any]],
     for e in events:
         if e.get("kind") == "counter":
             counters[e["name"]] = e["total"]
+    gauges: Dict[str, Any] = {}
+    for e in events:
+        if e.get("kind") == "gauge":
+            gauges[e["name"]] = e["value"]   # last write wins
 
     summary: Dict[str, Any] = {
         "schema_version": _SCHEMA_VERSION,
@@ -324,6 +352,7 @@ def summarize_events(events: List[Dict[str, Any]],
         "num_steady_steps": len(steady),
         "spans": spans,
         "counters": counters,
+        "gauges": gauges,
     }
     if steps:
         summary["final_loss"] = steps[-1]["loss"]

@@ -1,0 +1,22 @@
+"""Single-chip serving fast path (the ROADMAP north star's other half).
+
+The training side of this repo is evidence-closed; this package is the
+first measured serving surface: an AOT-compiled executable ladder over a
+fixed set of batch buckets (``engine``), a bounded-queue micro-batcher
+that coalesces concurrent requests into the largest ready bucket
+(``batcher``), double-buffered uint8 host staging reusing the training
+arena (``ingest``), a warm-start executable cache so a restarted server
+skips XLA compile (``cache``), and a seeded open-loop demo/measurement
+driver (``demo``).
+"""
+
+from .batcher import MicroBatcher, QueueFull, coalesce, plan_batches
+from .cache import ExecutableCache, executable_serialization_supported
+from .engine import BUCKETS, InferenceEngine
+from .ingest import StagedIngest
+
+__all__ = [
+    "BUCKETS", "ExecutableCache", "InferenceEngine", "MicroBatcher",
+    "QueueFull", "StagedIngest", "coalesce",
+    "executable_serialization_supported", "plan_batches",
+]

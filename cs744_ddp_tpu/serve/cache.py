@@ -1,0 +1,110 @@
+"""Warm-start executable cache: serialize compiled XLA executables to disk.
+
+Two layers make a restarted server skip compile:
+
+  * the repo-wide persistent XLA compilation cache
+    (``utils/compcache.enable_persistent_compilation_cache``) — enabled by
+    the engine at startup; it dedupes compiles ACROSS programs but still
+    pays lowering + cache lookup per bucket, and only persists compiles
+    over its 2 s threshold;
+  * this module — the whole compiled executable (``jax.jit(...).lower()
+    .compile()``) serialized via ``jax.experimental.serialize_executable``
+    and reloaded with zero XLA work, keyed by everything the executable
+    depends on (model/abstract-arg digest, bucket, dtype, jax version,
+    backend, device kind).  Where the installed jax lacks the API the
+    engine silently falls back to compiling (the persistent cache still
+    softens that path).
+
+Entries are pickles of ``(payload_bytes, in_tree, out_tree)`` written
+atomically (tmp + ``os.replace``) so a killed startup never leaves a torn
+entry; a stale or undeserializable entry is treated as a miss and
+recompiled over.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Any, Optional
+
+try:
+    from jax.experimental import serialize_executable as _se
+except ImportError:                      # pragma: no cover - older jax
+    _se = None
+
+
+def executable_serialization_supported() -> bool:
+    """Can this jax serialize/reload compiled executables?"""
+    return _se is not None
+
+
+def cache_key(**fields) -> str:
+    """Stable filename for an executable: sha256 over the sorted field
+    repr (model digest, bucket, dtype, jax/backend identity)."""
+    blob = repr(sorted(fields.items())).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+class ExecutableCache:
+    """Directory of serialized executables; ``None`` dir disables it."""
+
+    def __init__(self, cache_dir: Optional[str]):
+        self.cache_dir = cache_dir
+        self.hits = 0
+        self.misses = 0
+        if cache_dir is not None:
+            os.makedirs(cache_dir, exist_ok=True)
+
+    @property
+    def enabled(self) -> bool:
+        return self.cache_dir is not None and _se is not None
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, f"exec_{key}.pkl")
+
+    def load(self, key: str) -> Optional[Any]:
+        """Deserialize + load the executable for ``key``; None on miss or
+        any deserialization failure (a stale entry from another jax/device
+        is a miss, not an error)."""
+        if not self.enabled:
+            return None
+        path = self._path(key)
+        if not os.path.exists(path):
+            self.misses += 1
+            return None
+        try:
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+            loaded = _se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return loaded
+
+    def save(self, key: str, compiled) -> bool:
+        """Serialize ``compiled`` under ``key``; False when unsupported or
+        the executable refuses serialization (nothing breaks — the next
+        startup just compiles)."""
+        if not self.enabled:
+            return False
+        try:
+            payload, in_tree, out_tree = _se.serialize(compiled)
+            blob = pickle.dumps((payload, in_tree, out_tree))
+        except Exception:
+            return False
+        path = self._path(key)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return True
+
+    def stats(self) -> dict:
+        return {"dir": self.cache_dir, "supported": _se is not None,
+                "hits": self.hits, "misses": self.misses}

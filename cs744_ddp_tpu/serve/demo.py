@@ -1,0 +1,193 @@
+"""Seeded synthetic request traces + the open-loop serving demo driver.
+
+The serving numbers (bench.py ``serving`` section, ``cli.py
+--serve-demo``) come from replaying a DETERMINISTIC trace: Poisson
+arrivals at a configured offered load, request sizes drawn from a fixed
+mixture skewed toward small requests (the shape batched serving exists
+for), images sampled from the synthetic CIFAR stand-in.  Open loop:
+requests are submitted at their scheduled arrival times regardless of
+completion (offered load is the independent variable; queueing shows up
+in latency, not in a throttled arrival rate).  The driver records
+client-side latency (submit -> result) plus its own scheduling lag so a
+saturated single-core host cannot silently masquerade as a fast server.
+
+``python -m cs744_ddp_tpu.serve.demo --startup-probe ...`` prints one
+JSON line with the engine startup report — bench.py runs it twice in
+fresh subprocesses (same cache dirs) to measure COLD vs WARM startup
+honestly, outside any in-process jit cache.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data import cifar10
+from ..obs import Telemetry
+from ..obs.telemetry import percentile
+from .batcher import MicroBatcher, QueueFull
+from .engine import BUCKETS, InferenceEngine
+
+# Request-size mixture: mostly singletons and small groups, occasional
+# bulk requests — uniform over this tuple (seeded), mean ~8 images.
+SIZE_CHOICES = (1, 1, 1, 2, 4, 8, 16, 32)
+
+
+def request_pool(n_images: int = 2048, seed: int = 123) -> cifar10.Split:
+    """A small labeled image pool requests sample from (synthetic split —
+    generation is deterministic in ``seed``)."""
+    return cifar10._synthetic_split(n_images, seed=seed)
+
+
+def synthetic_trace(n_requests: int, *, offered_rps: float, seed: int,
+                    size_choices: Sequence[int] = SIZE_CHOICES
+                    ) -> List[Tuple[float, int]]:
+    """Seeded open-loop arrival trace: ``[(t_arrival_s, n_images), ...]``
+    with Exp(1/offered_rps) inter-arrivals, t starting at 0."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / offered_rps, size=n_requests)
+    gaps[0] = 0.0
+    times = np.cumsum(gaps)
+    sizes = rng.choice(np.asarray(size_choices, np.int64), size=n_requests)
+    return [(float(t), int(s)) for t, s in zip(times, sizes)]
+
+
+def run_demo(engine: InferenceEngine, *, n_requests: int = 200,
+             offered_rps: float = 20.0, seed: int = 0,
+             max_wait_ms: float = 5.0, max_queue_images: int = 1024,
+             pool: Optional[cifar10.Split] = None,
+             precision: str = "f32") -> dict:
+    """Replay one seeded open-loop trace through the micro-batcher;
+    returns the latency/throughput stats sheet."""
+    pool = pool if pool is not None else request_pool()
+    sizes = tuple(s for s in SIZE_CHOICES if s <= engine.max_batch)
+    trace = synthetic_trace(n_requests, offered_rps=offered_rps, seed=seed,
+                            size_choices=sizes)
+    rng = np.random.default_rng(seed + 1)
+    requests = []
+    for _, size in trace:
+        idx = rng.integers(0, len(pool.images), size=size)
+        requests.append((pool.images[idx], pool.labels[idx]))
+
+    results: List[Optional[float]] = [None] * len(trace)
+    rejected = 0
+    driver_lag_max = 0.0
+
+    def make_cb(i: int, t_submit: float):
+        def cb(fut):
+            if fut.exception() is None:
+                results[i] = time.time() - t_submit
+        return cb
+
+    with MicroBatcher(engine, max_wait_ms=max_wait_ms,
+                      max_queue_images=max_queue_images,
+                      precision=precision) as mb:
+        t0 = time.time()
+        for i, ((t_arr, _size), (imgs, labs)) in enumerate(
+                zip(trace, requests)):
+            delay = t0 + t_arr - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            else:
+                driver_lag_max = max(driver_lag_max, -delay)
+            try:
+                fut = mb.submit(imgs, labs)
+            except QueueFull:
+                rejected += 1
+                continue
+            fut.add_done_callback(make_cb(i, time.time()))
+        # stop() drains the queue before returning.
+    t_end = time.time()
+
+    lat_ms = [r * 1e3 for r in results if r is not None]
+    total_images = sum(s for _, s in trace)
+    done_images = sum(s for (_, s), r in zip(trace, results)
+                      if r is not None)
+    out = {
+        "n_requests": n_requests,
+        "offered_rps": offered_rps,
+        "seed": seed,
+        "max_wait_ms": max_wait_ms,
+        "completed": len(lat_ms),
+        "rejected": rejected,
+        "total_images": total_images,
+        "achieved_rps": round(len(lat_ms) / (t_end - t0), 2),
+        "images_per_sec": round(done_images / (t_end - t0), 2),
+        "driver_lag_ms_max": round(driver_lag_max * 1e3, 3),
+    }
+    if lat_ms:
+        out["latency_ms"] = {
+            "p50": round(percentile(lat_ms, 50), 3),
+            "p95": round(percentile(lat_ms, 95), 3),
+            "p99": round(percentile(lat_ms, 99), 3),
+            "mean": round(sum(lat_ms) / len(lat_ms), 3),
+            "max": round(max(lat_ms), 3),
+        }
+    tel = engine.telemetry
+    if tel.enabled:
+        totals = getattr(tel, "counter_totals", lambda: {})()
+        out["bucket_counts"] = {
+            k.replace("serve_bucket_", ""): int(v)
+            for k, v in sorted(totals.items())
+            if k.startswith("serve_bucket_")}
+    return out
+
+
+def parse_buckets(spec: str) -> Tuple[int, ...]:
+    return tuple(sorted({int(b) for b in spec.split(",") if b.strip()}))
+
+
+def startup_probe(model: str, *, buckets=BUCKETS, precisions=("f32",),
+                  cache_dir: Optional[str] = None, seed: int = 0,
+                  telemetry=None) -> dict:
+    """Build the ladder once and report the startup timing sheet."""
+    engine = InferenceEngine(model, buckets=buckets, precisions=precisions,
+                             cache_dir=cache_dir, seed=seed,
+                             telemetry=telemetry or Telemetry())
+    return engine.startup()
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser("serve.demo")
+    p.add_argument("--startup-probe", action="store_true",
+                   help="build the executable ladder, print the startup "
+                        "timing report as one JSON line, exit (bench.py "
+                        "runs this twice in fresh subprocesses for the "
+                        "cold/warm startup metric)")
+    p.add_argument("--model", default="vgg11")
+    p.add_argument("--buckets", default=",".join(map(str, BUCKETS)))
+    p.add_argument("--precisions", default="f32",
+                   help="comma list from {f32, bf16}")
+    p.add_argument("--cache-dir", default=None,
+                   help="executable-cache directory (warm start)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--requests", type=int, default=200)
+    p.add_argument("--load", type=float, default=20.0,
+                   help="offered load, requests/sec (open loop)")
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    args = p.parse_args(argv)
+
+    buckets = parse_buckets(args.buckets)
+    precisions = tuple(args.precisions.split(","))
+    tel = Telemetry()
+    engine = InferenceEngine(args.model, buckets=buckets,
+                             precisions=precisions,
+                             cache_dir=args.cache_dir, seed=args.seed,
+                             telemetry=tel)
+    report = engine.startup()
+    if args.startup_probe:
+        print(json.dumps(report))
+        return 0
+    stats = run_demo(engine, n_requests=args.requests,
+                     offered_rps=args.load, seed=args.seed,
+                     max_wait_ms=args.max_wait_ms)
+    print(json.dumps({"startup": report, "demo": stats}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

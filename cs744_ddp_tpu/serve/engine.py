@@ -1,0 +1,254 @@
+"""AOT batch-bucketed single-chip inference engine.
+
+Dynamic request sizes meet a compiler that specializes on shapes: compiling
+one program per request size would pay XLA compile latency on the serving
+path (seconds, vs a sub-millisecond forward).  The standard resolution is a
+fixed LADDER of batch buckets (e.g. {1, 8, 32, 128, 256}), every executable
+AOT-compiled at startup; a request batch of n images is padded to the
+smallest covering bucket and the pad rows are masked out of every reduced
+quantity with the SAME label = -1 convention the training eval path uses
+(``train/step.py::masked_eval_counts``), so serving and eval accounting
+cannot drift apart.  Per-row outputs (logits) are sliced back to n; with
+``train=False`` BatchNorm (running stats) every row is computed
+independently of its batchmates, so the sliced logits are BITWISE-identical
+(f32) to an unpadded direct forward — pinned in tests/test_serve.py.
+
+The forward program mirrors the windowed host path's transfer-compact
+design: uint8 in, the normalize fused into the XLA program
+(``data/augment.normalize``), optional bf16 compute with f32 logits out.
+
+Warm start: executables are looked up in a ``serve.cache.ExecutableCache``
+before compiling (and saved after), on top of the repo-wide persistent XLA
+compilation cache — cold vs warm startup seconds are a reported metric
+(``bench.py`` serving section), not an anecdote.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data import augment as aug
+from ..obs import NULL
+from ..utils import compcache
+from .cache import ExecutableCache, cache_key
+from .ingest import StagedIngest
+
+BUCKETS = (1, 8, 32, 128, 256)
+
+_DTYPES = {"f32": None}  # "bf16" resolved lazily (jnp import)
+
+
+class InferenceEngine:
+    """The executable ladder + padded/masked dispatch for one model.
+
+    ``state`` is a ``TrainState`` (or any object with ``params`` /
+    ``bn_state``) — typically restored from a training checkpoint; when
+    omitted the model is seed-initialized (the demo/bench mode, where
+    latency is the subject and weights are irrelevant).
+    """
+
+    def __init__(self, model: str = "vgg11", *,
+                 buckets: Sequence[int] = BUCKETS,
+                 precisions: Sequence[str] = ("f32",),
+                 state=None, seed: int = 0, telemetry=NULL,
+                 cache_dir: Optional[str] = None,
+                 use_staging: bool = True,
+                 enable_compilation_cache: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import get_model
+        from ..train.step import init_train_state, masked_eval_counts, \
+            maybe_cast
+
+        if not buckets:
+            raise ValueError("need at least one bucket")
+        if sorted(set(buckets)) != list(buckets):
+            raise ValueError(f"buckets must be strictly increasing, got "
+                             f"{tuple(buckets)}")
+        for p in precisions:
+            if p not in ("f32", "bf16"):
+                raise ValueError(f"unknown precision {p!r}")
+        if enable_compilation_cache:
+            # The repo-wide persistent XLA cache (satellite of the same
+            # PR wires it into cli.py startup): dedupes ladder compiles
+            # across server restarts even where executable serialization
+            # is unsupported.
+            compcache.enable_persistent_compilation_cache(compcache.repo_root())
+        self.model_name = model
+        self.buckets: Tuple[int, ...] = tuple(buckets)
+        self.precisions: Tuple[str, ...] = tuple(precisions)
+        self.telemetry = telemetry
+        init_fn, apply_fn = get_model(model)
+        if state is None:
+            state = init_train_state(init_fn, jax.random.PRNGKey(seed))
+        self.params = state.params
+        self.bn_state = state.bn_state
+        self._cache = ExecutableCache(cache_dir)
+        self._exec: Dict[Tuple[int, str], Any] = {}
+        self._ingest = (StagedIngest(max(self.buckets)) if use_staging
+                        else None)
+        self._jax = jax
+
+        def make_forward(compute_dtype):
+            def forward(params, bn_state, images_u8, labels):
+                x = maybe_cast(aug.normalize(images_u8), compute_dtype)
+                logits, _ = apply_fn(params, bn_state, x, train=False)
+                logits = logits.astype(jnp.float32)
+                loss_sum, correct = masked_eval_counts(logits, labels)
+                return logits, loss_sum, correct
+            return forward
+
+        self._forward = {"f32": make_forward(None),
+                         "bf16": make_forward(jnp.bfloat16)}
+
+        # Everything an executable's identity depends on beyond the bucket
+        # and dtype: the abstract model signature (param/bn shapes+dtypes,
+        # not values) and the toolchain/device identity.
+        d0 = jax.devices()[0]
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (self.params, self.bn_state))
+        self._key_fields = {
+            "model": model,
+            "abstract": (str(treedef),
+                         tuple((l.shape, str(l.dtype)) for l in leaves)),
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "device_kind": getattr(d0, "device_kind", str(d0)),
+        }
+
+    # -- ladder -------------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket covering ``n`` requests."""
+        if n < 1:
+            raise ValueError(f"need at least one image, got {n}")
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"request batch {n} exceeds the largest bucket "
+                         f"{self.buckets[-1]}; split it upstream "
+                         f"(the micro-batcher never builds one this big)")
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def _abstract_args(self, bucket: int):
+        import jax
+        import jax.numpy as jnp
+        to_s = lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype)
+        return (jax.tree_util.tree_map(to_s, self.params),
+                jax.tree_util.tree_map(to_s, self.bn_state),
+                jax.ShapeDtypeStruct((bucket, 32, 32, 3), jnp.uint8),
+                jax.ShapeDtypeStruct((bucket,), jnp.int32))
+
+    def startup(self) -> dict:
+        """Build the whole ladder (cache-load or AOT-compile every
+        (bucket, precision) executable); returns the timing report the
+        bench's cold/warm startup metric is made of."""
+        import jax
+
+        t0 = time.time()
+        per: Dict[str, dict] = {}
+        for prec in self.precisions:
+            for b in self.buckets:
+                t1 = time.time()
+                key = cache_key(bucket=b, precision=prec,
+                                **self._key_fields)
+                compiled = self._cache.load(key)
+                source = "cache"
+                if compiled is None:
+                    source = "compile"
+                    if self.telemetry.enabled:
+                        with self.telemetry.span("serve_compile", bucket=b,
+                                                 precision=prec):
+                            compiled = self._compile(prec, b)
+                    else:
+                        compiled = self._compile(prec, b)
+                    self._cache.save(key, compiled)
+                self._exec[(b, prec)] = compiled
+                name = f"{b}/{prec}" if len(self.precisions) > 1 else str(b)
+                per[name] = {"seconds": round(time.time() - t1, 4),
+                             "source": source}
+        report = {
+            "startup_s": round(time.time() - t0, 4),
+            "per_bucket": per,
+            "warm": all(v["source"] == "cache" for v in per.values()),
+            "executable_cache": self._cache.stats(),
+            "backend": jax.default_backend(),
+        }
+        if self.telemetry.enabled:
+            self.telemetry.gauge("serve_startup_s", report["startup_s"],
+                                 warm=report["warm"])
+        return report
+
+    def _compile(self, precision: str, bucket: int):
+        jit = self._jax.jit(self._forward[precision])
+        return jit.lower(*self._abstract_args(bucket)).compile()
+
+    def _executable(self, bucket: int, precision: str):
+        ex = self._exec.get((bucket, precision))
+        if ex is None:   # lazy build for direct-use paths without startup()
+            key = cache_key(bucket=bucket, precision=precision,
+                            **self._key_fields)
+            ex = self._cache.load(key)
+            if ex is None:
+                ex = self._compile(precision, bucket)
+                self._cache.save(key, ex)
+            self._exec[(bucket, precision)] = ex
+        return ex
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _pad_stage(self, images: np.ndarray, bucket: int):
+        """Pad the request batch to ``bucket`` rows and move it to device
+        (double-buffered arena staging when available; plain padded copy
+        otherwise)."""
+        if self._ingest is not None:
+            return self._ingest.stage(images, bucket)
+        padded = np.zeros((bucket, 32, 32, 3), np.uint8)
+        padded[:len(images)] = images
+        return padded
+
+    def infer_counts(self, images: np.ndarray, labels=None, *,
+                     precision: str = "f32"):
+        """Forward a request batch of n <= max_batch images.
+
+        Returns ``(logits[n, 10] f32, loss_sum, correct)``; pad rows carry
+        label -1 and contribute NOTHING to loss_sum/correct (the
+        ``masked_eval_counts`` convention).  Unlabeled requests (labels
+        None) get all -1 labels, so both counts are exactly 0.
+        """
+        images = np.ascontiguousarray(images, np.uint8)
+        n = images.shape[0]
+        bucket = self.bucket_for(n)
+        ex = self._executable(bucket, precision)
+        padded_labels = np.full((bucket,), -1, np.int32)
+        if labels is not None:
+            padded_labels[:n] = np.asarray(labels, np.int32)
+        staged = self._pad_stage(images, bucket)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter(f"serve_bucket_{bucket}")
+            with tel.span("serve_dispatch", bucket=bucket, n=n):
+                logits, loss_sum, correct = ex(self.params, self.bn_state,
+                                               staged, padded_labels)
+            with tel.span("serve_fetch", bucket=bucket):
+                out = np.asarray(logits)[:n]
+                counts = (float(loss_sum), int(correct))
+        else:
+            logits, loss_sum, correct = ex(self.params, self.bn_state,
+                                           staged, padded_labels)
+            out = np.asarray(logits)[:n]
+            counts = (float(loss_sum), int(correct))
+        return out, counts[0], counts[1]
+
+    def infer(self, images: np.ndarray, *,
+              precision: str = "f32") -> np.ndarray:
+        """Logits [n, 10] f32 for n <= max_batch uint8 images."""
+        logits, _, _ = self.infer_counts(images, precision=precision)
+        return logits

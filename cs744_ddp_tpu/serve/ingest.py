@@ -1,0 +1,73 @@
+"""Double-buffered uint8 request staging (the serving ingest path).
+
+Reuses the training pipeline's ``native.StagingArena``: two 64-byte-aligned
+host buffers sized to the largest bucket, handed out round-robin with a
+per-slot transfer fence, so assembling request batch k+1 overlaps the
+device transfer of batch k instead of waiting behind it.  Pad rows are
+zeroed at fill time (the engine masks them out by label; zeroing keeps the
+staged bytes deterministic so bucketed dispatch is reproducible
+byte-for-byte).
+
+The same CPU-client aliasing caveat as training applies: jax's CPU backend
+zero-copies suitably aligned committed numpy buffers, and a reused arena
+row would then corrupt an in-flight batch.  The arena behavior is probed
+EMPIRICALLY once (same design as ``Trainer._probe_put_aliases_host``) and
+rows are put as private copies where aliasing is detected — exactly where
+no real host->device link exists, so the copy costs nothing that matters.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data import native
+
+
+class StagedIngest:
+    """Bounded double-buffered uint8 staging onto the default device."""
+
+    def __init__(self, max_batch: int, nslots: int = 2):
+        self._max_batch = max_batch
+        self._arena = native.StagingArena(nslots, 1, max_batch)
+        self._put_copies = None   # aliasing probe result, resolved lazily
+
+    @property
+    def nslots(self) -> int:
+        return self._arena.nslots
+
+    def _probe_put_aliases_host(self, buf: np.ndarray) -> bool:
+        """Does ``device_put`` of this arena row alias the host memory?
+        (See ``native.StagingArena`` docstring; aliasing depends on
+        backend + alignment, so it is probed, not assumed.)"""
+        import jax
+        before = int(buf.flat[0])
+        x = jax.device_put(buf)
+        jax.block_until_ready(x)
+        buf.flat[0] = np.uint8(before ^ 0xFF)
+        aliased = int(np.asarray(jax.device_get(x)).flat[0]) != before
+        buf.flat[0] = before
+        return aliased
+
+    def stage(self, images: np.ndarray, bucket: int):
+        """Fill the next arena slot with ``images`` padded to ``bucket``
+        rows (zeros) and start its host->device transfer; returns the
+        device array [bucket, 32, 32, 3] uint8."""
+        import jax
+
+        n = len(images)
+        if not (0 < n <= bucket <= self._max_batch):
+            raise ValueError(f"cannot stage {n} images into bucket "
+                             f"{bucket} (max {self._max_batch})")
+        slot, buf = self._arena.acquire()
+        row = buf[0]
+        if self._put_copies is None:
+            self._put_copies = any(
+                self._probe_put_aliases_host(self._arena.buffer(s)[0])
+                for s in range(self._arena.nslots))
+        row[:n] = images
+        if n < bucket:
+            row[n:bucket] = 0
+        src = row[:bucket]
+        handle = jax.device_put(src.copy() if self._put_copies else src)
+        self._arena.retire(slot, handle)
+        return handle

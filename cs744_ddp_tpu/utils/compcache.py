@@ -1,20 +1,61 @@
-"""Persistent XLA compilation cache shared by bench.py and the test suite.
+"""Persistent XLA compilation cache shared by bench.py, cli.py, serve/ and
+the test suite.
 
 One knob, one location: the cache lives under <repo>/.jax_cache (gitignored)
 and entries below the min-compile-time threshold are not persisted.
+
+Hit/miss accounting: jax reports cache traffic through ``jax.monitoring``
+events; a process-wide listener tallies them so the per-run telemetry
+manifest can record whether this run's compiles actually came from the
+cache (``cache_stats`` — a silent cache regression otherwise just looks
+like a slow day).
 """
 
 from __future__ import annotations
 
 import os
 
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_counts = {"hits": 0, "misses": 0}
+_listener_on = False
+_enabled_dir: "str | None" = None
+
+
+def repo_root() -> str:
+    """The checkout root (two levels above this file's package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _listen(event: str, **kw) -> None:
+    if event == _HIT_EVENT:
+        _counts["hits"] += 1
+    elif event == _MISS_EVENT:
+        _counts["misses"] += 1
+
 
 def enable_persistent_compilation_cache(repo_root: str) -> None:
     """Best-effort: older jax without the config knobs just runs uncached."""
+    global _listener_on, _enabled_dir
     try:
         import jax
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(repo_root, ".jax_cache"))
+        cache_dir = os.path.join(repo_root, ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+        _enabled_dir = cache_dir
+        if not _listener_on:
+            from jax import monitoring
+            monitoring.register_event_listener(_listen)
+            _listener_on = True
     except Exception:
         pass
+
+
+def cache_stats() -> dict:
+    """Cache location + hit/miss tallies since the listener went up —
+    recorded in the telemetry run manifest (cli.py) so compile-cache
+    regressions are visible per run."""
+    return {"dir": _enabled_dir, "enabled": _enabled_dir is not None,
+            "hits": _counts["hits"], "misses": _counts["misses"]}

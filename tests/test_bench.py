@@ -38,6 +38,9 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
                              spectrum_deep_rows=(("tiny", "gather"),),
                              headline_model="tiny",
                              peak_batch_candidates=(8, 16),
+                             serving_kwargs=dict(
+                                 buckets=(2, 4, 8), loads=(50.0,),
+                                 n_requests=20, startup_probe=False),
                              log=lambda s: None)
     # Driver contract head.
     assert result["metric"] == "cifar10_tiny_images_per_sec_per_chip"
@@ -90,13 +93,12 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     assert "chunk_wait" in hts["spans"]
 
     # Convergence entries: the reference's own correctness signal (VERDICT
-    # r4 item 3).  On this toolchain's init draw the reference lr=0.1 lands
-    # the tiny model in the SAME degenerate minimum round 5 measured for
-    # VGG-11 on the synthetic set (loss asymptote ~2.295, chance-level
-    # accuracy; lr 0.05/0.01 reach 100%), so the reference-lr trajectory is
-    # reported/structurally checked while the LEARNING oracle rides on the
-    # stable_lr companion — the entry bench.py added for exactly this
-    # failure mode.
+    # r4 item 3).  At THIS test's shrunken 768-image scale the round-7
+    # recalibrated task (data/cifar10.py) leaves accuracy near the chance
+    # floor — too few samples per class/template to generalize — so here
+    # only the SHAPE of the entries is checked (losses still fall).  The
+    # graded LEARNING oracle runs at its calibrated 12.8k-image scale in
+    # test_bench_convergence_oracle_graded below.
     conv = result["convergence"]
     assert conv["real_data"] is False   # tmp_path has no CIFAR pickles
     assert len(conv["per_epoch"]) == 3
@@ -113,16 +115,24 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     if ts["num_steady_steps"]:
         stt = ts["steady_step_time_s"]
         assert stt["p50"] <= stt["p95"] <= stt["p99"] <= stt["max"]
-    # Stable-lr companion (the reference lr collapses models on the
-    # synthetic set — bench.py rationale): THE learning oracle here.  A
-    # stalled or half-broken step stays at the 10% chance floor; this
-    # config measures 100% after one epoch (lr 0.01, deterministic seed).
+    # Stable-lr companion: shape-checked only at this scale (see the
+    # comment above conv; the >=2x-chance floor moved to the dedicated
+    # oracle test at the calibrated dataset size).
     st = conv["stable_lr"]
     assert 0.0 <= st["test_accuracy_pct"] <= 100.0
-    assert st["test_accuracy_pct"] >= 20.0, st  # >= 2x the chance floor
-    # >= 0: losses are rounded to 4 decimals and this config can fit the
-    # synthetic set to ~0 loss (that is the entry's whole point).
     assert st["test_avg_loss"] >= 0 and st["train_loss_last"] >= 0
+
+    # Serving section: ladder startup + per-bucket curve + open-loop
+    # latency entry (full serving behavior is pinned in tests/test_serve.py;
+    # here the subject is the section's shape inside the bench artifact).
+    sv = result["serving"]
+    assert sv["model"] == "tiny"
+    assert set(sv["throughput_vs_bucket"]) == {"2", "4", "8"}
+    for e in sv["throughput_vs_bucket"].values():
+        assert e["images_per_sec"] > 0
+        assert e["per_dispatch_ms"] > 0 and e["device_program_ms"] > 0
+    assert sv["latency"]["50rps"]["completed"] > 0
+    assert "serve_dispatch" in sv["telemetry_summary"]["spans"]
 
     # Scaling sweep: 1,2,4,8 devices; WEAK scaling (constant per-chip
     # batch); efficiency is per-chip relative to the 1-device run and must
@@ -180,6 +190,57 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     assert json.loads(sidecar.read_text()) == result      # auditable copy
 
 
+@pytest.mark.slow  # ~3 min: 4 tiny-model epochs at the calibrated scale
+def test_bench_convergence_oracle_graded(tmp_path, monkeypatch):
+    """The CI learning floor, re-derived for the round-7 recalibrated
+    synthetic task (satellite of the serving PR; data/cifar10.py knob
+    comments + BASELINE.md "Synthetic-task recalibration (round 7)").
+
+    Pinned at the reference's own Part-1 semantics — ONE worker,
+    ``single`` strategy — because that is where the recalibration is
+    defined: under this mesh's 8-way ddp the per-shard BN batch is 8 and
+    the lr-0.1 trajectory sits at chance (measured 9.77/9.77/9.77 at
+    global batch 64 and 14.1/11.7/15.6 at 256), an artifact of the
+    virtual mesh, not of the task.  At the calibrated 12.8k-image scale
+    the reference config must show a GRADED trajectory — rising epoch
+    over epoch, above chance, below the label-noise ceiling (measured:
+    16.02 / 32.03 / 34.57%, losses 2.2517 / 2.0924 / 1.9515) — and the
+    stable-lr companion must clear 2.5x chance in one epoch (measured:
+    50.00% single / 50.39% ddp).  Floors carry ~2x margin against
+    seed/toolchain drift."""
+    monkeypatch.setenv("CIFAR_DATA_DIR", str(tmp_path))
+    from cs744_ddp_tpu.data import cifar10
+    monkeypatch.setattr(cifar10, "TRAIN_SIZE", 64 * 200)
+    monkeypatch.setattr(cifar10, "TEST_SIZE", 256)
+    from cs744_ddp_tpu.ops import sgd as _sgd
+
+    # Reference config (lr 0.1, SGD 0.1/0.9/1e-4 — Trainer default),
+    # 3 epochs: the graded trajectory itself.
+    tr = bench._make_trainer("tiny", "single", 1, global_batch=64,
+                             data_dir=str(tmp_path), log=lambda s: None)
+    assert tr.real_data is False
+    accs, losses = [], []
+    for ep in range(3):
+        timers = tr.train_model(ep)
+        _, _, acc = tr.test_model()
+        accs.append(acc)
+        losses.append(timers.losses[-1])
+    # Graded: learning is under way but NOT saturated.
+    assert accs[-1] > accs[0], accs          # rises across the window
+    assert accs[-1] >= 20.0, accs            # >= 2x the 10% chance floor
+    assert accs[-1] <= 90.0, accs            # below the ~91% noise ceiling
+    assert losses[0] > losses[-1], losses    # train loss falls too
+
+    # Stable-lr companion (bench.py's convergence section records the
+    # same pair): decisively above chance after ONE epoch.
+    tr2 = bench._make_trainer("tiny", "single", 1, global_batch=64,
+                              data_dir=str(tmp_path), log=lambda s: None,
+                              sgd_cfg=_sgd.SGDConfig(lr=0.01))
+    tr2.train_model(0)
+    _, _, acc2 = tr2.test_model()
+    assert acc2 >= 25.0, acc2                # half the measured 50%
+
+
 def test_matrix_pairs_prunes_world1_strategy_cross():
     models = ("vgg11", "resnet18")
     strategies = ("gather", "allreduce", "ddp")
@@ -220,6 +281,60 @@ def test_emit_result_contract_and_head_budget(tmp_path, capsys):
     huge = dict(result, metric="m" * 2 * bench.HEAD_LINE_BUDGET)
     with pytest.raises(RuntimeError, match="budget"):
         bench.emit_result(huge, str(sidecar), out=lambda s: None)
+
+
+def test_emit_head_budget_worst_case_with_serving(tmp_path):
+    """Satellite of the serving PR: a worst-case result — every head field
+    at realistic maximal width PLUS a fat ``serving`` section — must still
+    emit a FINAL stdout line within the driver budget that JSON-parses
+    standalone.  Pins that growing the full payload (new sections) cannot
+    regress the r04/r05 parsed-null failure: bulk rides in the sidecar, the
+    head's size is a function of CONTRACT_KEYS alone."""
+    serving = {
+        "backend": "tpu", "model": "vgg11",
+        "buckets": [1, 8, 32, 128, 256], "precision": "f32",
+        "ladder_startup": {"startup_s": 123.4567, "per_bucket": {
+            str(b): {"seconds": 23.4567, "source": "compile"}
+            for b in (1, 8, 32, 128, 256)}, "warm": False},
+        "throughput_vs_bucket": {str(b): {
+            "per_dispatch_ms": 104.321, "device_program_ms": 4.321,
+            "images_per_sec": 59259.26, "reps": 20}
+            for b in (1, 8, 32, 128, 256)},
+        "latency": {f"{rps}rps": {
+            "n_requests": 200, "offered_rps": rps, "completed": 200,
+            "rejected": 0, "latency_ms": {
+                "p50": 105.123, "p95": 230.456, "p99": 480.789,
+                "mean": 131.415, "max": 512.161}}
+            for rps in (5.0, 20.0, 80.0)},
+        "startup": {"method": "subprocess", "cold_s": 240.1234,
+                    "warm_s": 3.4567, "warm_lt_half_cold": True},
+        "telemetry_summary": {"spans": {"serve_dispatch": {
+            "count": 999999, "total_s": 12345.6789}},
+            "padding": "x" * 2000},
+    }
+    result = {
+        "metric": "cifar10_vgg11_images_per_sec_per_chip",
+        "value": 123456.78, "unit": "images/sec/chip",
+        "vs_baseline": 3173.95, "num_devices": 256,
+        "headline_stats": {"runs": [123456.78, 123400.12, 123399.99],
+                           "best": 123456.78, "median": 123400.12,
+                           "min": 123399.99},
+        "tflops_per_sec": 123.45, "mfu_vs_bf16_peak": 0.6266,
+        "serving": serving,
+        "matrix": {"bulk": "y" * 4000},
+    }
+    lines = []
+    head = bench.emit_result(result, str(tmp_path / "FULL.json"),
+                             out=lines.append)
+    final = lines[-1]
+    assert len(final.encode()) <= bench.HEAD_LINE_BUDGET
+    parsed = json.loads(final)               # standalone-parseable
+    assert parsed == head
+    assert parsed["value"] == result["value"]
+    assert "serving" not in parsed           # bulk stays in the sidecar
+    assert parsed["full_payload_file"] == "FULL.json"
+    assert json.loads((tmp_path / "FULL.json").read_text())["serving"] \
+        == serving
 
 
 def test_bench_require_real_data_gate(tmp_path, monkeypatch):

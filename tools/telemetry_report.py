@@ -18,11 +18,47 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from cs744_ddp_tpu.obs import read_run, summarize_events  # noqa: E402
-from cs744_ddp_tpu.obs.telemetry import read_events_jsonl  # noqa: E402
+from cs744_ddp_tpu.obs.telemetry import (percentile,  # noqa: E402
+                                         read_events_jsonl)
 
 
 def _fmt_ms(seconds: float) -> str:
     return f"{seconds * 1e3:.2f} ms"
+
+
+def _serving_lines(events) -> list:
+    """Serving-path rendering (serve/ + --serve-demo runs): the queue-depth
+    trace and per-bucket client-latency percentiles, both rebuilt from raw
+    gauge events (``queue_depth``; ``serve_latency_ms`` with its ``bucket``
+    attr).  Returns [] for runs with no serving events — training-run
+    reports are unchanged."""
+    depth, lat = [], {}
+    for e in events:
+        if e.get("kind") != "gauge":
+            continue
+        if e.get("name") == "queue_depth":
+            depth.append(e["value"])
+        elif e.get("name") == "serve_latency_ms":
+            lat.setdefault(e.get("bucket", "?"), []).append(e["value"])
+    if not depth and not lat:
+        return []
+    lines = ["== serving =="]
+    if depth:
+        lines.append(f"  queue_depth (images)   samples {len(depth)}  "
+                     f"max {max(depth)}  "
+                     f"mean {sum(depth) / len(depth):.1f}  "
+                     f"last {depth[-1]}")
+    if lat:
+        lines.append("  request latency by bucket (client-side, "
+                     "enqueue -> logits):")
+        for b in sorted(lat, key=str):
+            v = lat[b]
+            lines.append(f"    bucket {b!s:<6} x{len(v):<6} "
+                         f"p50 {percentile(v, 50):8.2f} ms  "
+                         f"p95 {percentile(v, 95):8.2f} ms  "
+                         f"p99 {percentile(v, 99):8.2f} ms")
+    lines.append("")
+    return lines
 
 
 def render(out_dir: str) -> str:
@@ -86,6 +122,8 @@ def render(out_dir: str) -> str:
         for name, total in sorted(summary["counters"].items()):
             lines.append(f"  {name:<34} {total}")
         lines.append("")
+
+    lines.extend(_serving_lines(events))
 
     gauges = {}
     for e in events:
