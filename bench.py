@@ -84,6 +84,7 @@ import json
 import os
 import statistics
 import sys
+from typing import Optional
 
 # Reference stack on this host (torch CPU, batch 256): images/sec.
 # Measured with tools/bench_torch_baseline.py (38.9 img/s); see BASELINE.md.
@@ -290,8 +291,8 @@ def _collect_spectrum(log, model: str, global_batch: int,
     from cs744_ddp_tpu.parallel import get_strategy
     from cs744_ddp_tpu.parallel.mesh import DATA_AXIS
     from cs744_ddp_tpu.train import step as steplib
-    from cs744_ddp_tpu.utils.hlo_stats import (collective_chain_depth,
-                                               collective_stats)
+    from cs744_ddp_tpu.analysis import (collective_chain_depth,
+                                        collective_stats)
 
     try:
         from jax.experimental import topologies
@@ -664,6 +665,23 @@ def run_serving(log, *, model: str = "vgg11", buckets=None,
         "ladder_startup": ladder,
     }
 
+    # Static audit of the executable ladder we are about to measure: each
+    # bucket's program must be collective-free, precision-clean and
+    # constant-lean (analysis/audit.py).  Tolerant — the audit must never
+    # kill a serving bench whose measurements matter more than its paper
+    # trail.
+    try:
+        from cs744_ddp_tpu.analysis import audit as _auditlib
+        audit_res = _auditlib.AuditResult(
+            reports=_auditlib.audit_serving(engine=engine,
+                                            precision=precision))
+        out["audit"] = audit_res.summary()
+        log(f"[bench] serving: audit "
+            f"{'CLEAN' if audit_res.clean else 'DIRTY'} over "
+            f"{len(audit_res.reports)} bucket programs")
+    except Exception as e:   # noqa: BLE001 - advisory section
+        log(f"[bench] serving: ladder audit failed ({e!r}); omitted")
+
     # Throughput-vs-bucket curve.  The rep count adapts to the measured
     # per-dispatch time so a slow rung (vgg11/256 on a 1-core CPU host)
     # costs ~dispatch_budget_s, not dispatch_reps x seconds.
@@ -716,11 +734,43 @@ def run_serving(log, *, model: str = "vgg11", buckets=None,
     return out
 
 
+def run_audit(log, *, headline_model: str = "vgg11",
+              global_batch: int = 256) -> Optional[dict]:
+    """Static program audit (``cs744_ddp_tpu/analysis/audit.py``) over the
+    full shipped-program zoo on THIS host's devices: every train path x
+    strategy, the eval window and the serving ladder, certified against
+    their per-strategy cost contracts (collective shapes + the depth
+    ladder, dtype leaks, donation, host syncs, baked constants).  The
+    bench artifact carries the certification next to the numbers it
+    certifies.  None (with a logged reason) when auditing fails — the
+    section is advisory, never fatal to a finished measurement run."""
+    import jax
+
+    from cs744_ddp_tpu.analysis import audit as auditlib
+
+    log = log or (lambda s: print(s, file=sys.stderr))
+    ndev = len(jax.devices())
+    log(f"[bench] audit: program zoo for {headline_model} on {ndev} "
+        "device(s)")
+    try:
+        res = auditlib.audit_zoo(model=headline_model,
+                                 global_batch=global_batch,
+                                 serve_buckets=(1, 8),
+                                 num_devices=ndev)
+    except Exception as e:   # noqa: BLE001 - advisory section
+        log(f"[bench] audit: zoo audit failed ({e!r}); section omitted")
+        return None
+    for line in res.format_lines():
+        log(f"[bench] {line}")
+    return res.summary()
+
+
 def run_bench(*, matrix: bool = True, sweep: bool = True,
               peak: bool = True, convergence: bool = True,
               convergence_epochs: int = 3,
               spectrum: bool = True, host_pipeline: bool = True,
               robustness: bool = True, serving: bool = True,
+              audit: bool = True,
               serving_kwargs=None,
               max_iters: int = 100,
               global_batch: int = 256,
@@ -1030,6 +1080,14 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         result["serving"] = run_serving(log, model=headline_model,
                                         **(serving_kwargs or {}))
 
+    # Static program audit: the zoo's cost-shape certification rides in
+    # the artifact next to the measurements it certifies.
+    if audit:
+        audit_summary = run_audit(log, headline_model=headline_model,
+                                  global_batch=global_batch)
+        if audit_summary is not None:
+            result["audit"] = audit_summary
+
     if sweep:
         # WEAK scaling: per-chip batch held at ``global_batch`` while the
         # mesh grows (global = global_batch x n).  The north star is
@@ -1184,6 +1242,9 @@ def main(argv=None) -> None:
                    help="skip the serving fast-path section (bucket "
                         "throughput curve, open-loop latency, cold/warm "
                         "startup)")
+    p.add_argument("--no-audit", action="store_true",
+                   help="skip the static program-zoo audit section "
+                        "(analysis/audit.py cost-shape certification)")
     p.add_argument("--max-iters", type=int, default=100,
                    help="minimum steady-state iterations per config")
     p.add_argument("--global-batch", type=int, default=256)
@@ -1221,6 +1282,7 @@ def main(argv=None) -> None:
                        robustness=not (args.no_robustness
                                        or args.no_matrix),
                        serving=not (args.no_serving or args.no_matrix),
+                       audit=not (args.no_audit or args.no_matrix),
                        max_iters=args.max_iters,
                        global_batch=args.global_batch)
     emit_result(result, args.full_out or os.path.join(

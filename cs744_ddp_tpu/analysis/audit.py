@@ -1,0 +1,616 @@
+"""Rule engine certifying every compiled program's cost shape.
+
+The paper's subject IS the cost structure of each gradient-sync tier —
+gather→scatter pays two chained collectives per leaf with world-x traffic,
+per-param all-reduce one per leaf, bucketed DDP one per ~25 MB bucket —
+and until now that structure was only *reported* (bench ``spectrum``),
+never *checked*.  This module audits the pre-optimization HLO (via the
+:mod:`analysis.hlo_ir` graph IR) plus the jaxpr of each shipped program
+against a declared :class:`ProgramContract`, so a regression in comms
+shape, precision, buffer donation or host syncs fails CI before any
+hardware run.
+
+Rules (each one catches a deliberately seeded violation in
+tests/test_analysis.py):
+
+- ``collective-contract`` — per-strategy count / byte / chain-depth
+  certification: ``single`` (and every world-1 or serving program)
+  lowers zero collectives; ``gather`` >= nleaves all-gathers with
+  world-amplified result bytes and a 2-per-leaf chain; ``allreduce``
+  >= nleaves all-reduces chained >= nleaves deep; ``ddp`` all-reduces
+  chained exactly per-bucket — STRICTLY shallower than per-param when
+  there are fewer buckets than leaves (the DDP fusion win, Li et al.,
+  VLDB 2020).  The cross-strategy depth ladder
+  (ddp < allreduce < gather) is certified whenever several strategies
+  are audited together.
+- ``dtype-leak`` — no f32/f64 ``dot``/``convolution`` in a
+  bf16-declared program (a silent promotion doubles MXU cost).
+- ``donation`` — programs declared to donate the train state must
+  donate >= n_state_leaves entry buffers (``buffer_donor`` /
+  ``input_output_alias`` module header); a miss doubles peak HBM.
+- ``host-sync`` — no infeed/outfeed/send/recv or host-callback
+  custom-calls inside ``while`` bodies (HLO side), and no callback
+  primitives inside ``scan``/``while`` sub-jaxprs (jaxpr side): a host
+  round-trip per scanned step serializes the window pipeline.
+- ``baked-constants`` — no single constant larger than the contract's
+  ``max_constant_bytes`` baked into the executable (weights and data
+  must arrive as arguments, not literals).
+
+Waiver syntax (CLI ``--audit-waive``, bench, tests): ``RULE`` waives a
+rule everywhere, ``RULE@GLOB`` only for programs matching the fnmatch
+glob, e.g. ``baked-constants@serve/*``.  Waived findings are still
+reported and recorded in the telemetry manifest, they just don't fail
+``--audit strict``.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import hlo_ir, stats
+
+DEFAULT_MAX_CONSTANT_BYTES = 1 << 20     # 1 MiB: far above any mask/iota
+                                         # table, far below weights/data
+
+_HOST_SYNC_OPS = frozenset(
+    {"infeed", "outfeed", "send", "recv", "send-done", "recv-done"})
+_CALLBACK_TARGET_RE = re.compile(r"callback|host", re.IGNORECASE)
+_LOOP_PRIMITIVES = frozenset({"while", "scan"})
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    program: str
+    message: str
+
+
+@dataclass
+class ProgramContract:
+    """What a program's lowering is REQUIRED to look like."""
+    name: str
+    strategy: Optional[str] = None       # single/gather/allreduce/ddp/eval;
+                                         # None = no collectives expected
+    world: int = 1
+    nleaves: int = 0                     # parameter (grad) leaves
+    nbuckets: int = 0                    # ddp bucket count
+    param_bytes: int = 0                 # total parameter bytes (f32 master)
+    n_state_leaves: int = 0              # TrainState leaves (donation floor)
+    donates_state: bool = False
+    precision: str = "f32"
+    max_constant_bytes: int = DEFAULT_MAX_CONSTANT_BYTES
+
+
+@dataclass
+class AuditReport:
+    program: str
+    findings: List[Finding] = field(default_factory=list)
+    waived: List[Finding] = field(default_factory=list)
+    rules: Dict[str, str] = field(default_factory=dict)  # rule -> pass/fail/waived
+    stats: Dict = field(default_factory=dict)            # collective shape record
+
+    @property
+    def passed(self) -> bool:
+        return not self.findings
+
+
+def _waived(finding: Finding, waivers: Sequence[str]) -> bool:
+    for w in waivers:
+        rule, _, prog_glob = w.partition("@")
+        if rule != finding.rule:
+            continue
+        if not prog_glob or fnmatch.fnmatch(finding.program, prog_glob):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+def _rule_collective_contract(module: hlo_ir.Module, jaxpr,
+                              c: ProgramContract) -> List[Finding]:
+    s = stats.collective_stats(module)
+    by = stats.collective_bytes(module)
+    depth = stats.collective_chain_depth(module)
+    counts = {op: e["count"] for op, e in s["ops"].items()}
+    total = s["total_count"]
+    out: List[Finding] = []
+
+    def bad(msg: str) -> None:
+        out.append(Finding("collective-contract", c.name, msg))
+
+    if c.strategy is None or c.strategy == "single" or c.world <= 1:
+        if total:
+            bad(f"expected a collective-free program, found {counts} "
+                f"(chain depth {depth})")
+        return out
+
+    ar = counts.get("all-reduce", 0)
+    ag = counts.get("all-gather", 0)
+    others = {op: n for op, n in counts.items()
+              if op not in ("all-reduce", "all-gather")}
+
+    if c.strategy == "eval":
+        if ag or others:
+            bad(f"eval must reduce only (all-reduce); found {counts}")
+        if ar < 1:
+            bad("eval on a multi-device mesh must psum its counts; "
+                "found no all-reduce")
+        if depth > 2:
+            bad(f"eval collective chain depth {depth} > 2: eval reductions "
+                f"must not serialize")
+        return out
+
+    if c.strategy == "gather":
+        if ag < c.nleaves:
+            bad(f"gather tier must all-gather every grad leaf: "
+                f"{ag} all-gather < {c.nleaves} leaves")
+        if ar < 1:
+            bad("gather tier reduces gathered grads; found no all-reduce")
+        if depth < 2 * c.nleaves:
+            bad(f"gather tier chains two collectives per leaf: depth "
+                f"{depth} < {2 * c.nleaves}")
+        want = c.world * c.param_bytes
+        if c.param_bytes and by.get("all-gather", 0) < want:
+            bad(f"gather traffic amplification missing: all-gather result "
+                f"bytes {by.get('all-gather', 0)} < world x params = {want}")
+        return out
+
+    if c.strategy == "allreduce":
+        if ag or others:
+            bad(f"per-param all-reduce tier must emit only all-reduce; "
+                f"found {counts}")
+        if ar < c.nleaves:
+            bad(f"per-param tier reduces every leaf: {ar} all-reduce < "
+                f"{c.nleaves} leaves")
+        if depth < c.nleaves:
+            bad(f"per-param tier chains one collective per leaf: depth "
+                f"{depth} < {c.nleaves}")
+        if c.param_bytes and by.get("all-reduce", 0) < c.param_bytes:
+            bad(f"all-reduce result bytes {by.get('all-reduce', 0)} < "
+                f"total param bytes {c.param_bytes}")
+        return out
+
+    if c.strategy == "ddp":
+        if ag or others:
+            bad(f"ddp tier must emit only all-reduce; found {counts}")
+        if ar < c.nbuckets:
+            bad(f"ddp tier reduces every bucket: {ar} all-reduce < "
+                f"{c.nbuckets} buckets")
+        if depth < c.nbuckets:
+            bad(f"ddp chain depth {depth} < {c.nbuckets} buckets")
+        if c.nleaves > c.nbuckets and depth >= c.nleaves:
+            bad(f"ddp fusion win lost: chain depth {depth} >= {c.nleaves} "
+                f"leaves — bucketed reduces are serializing per leaf")
+        if c.param_bytes and by.get("all-reduce", 0) < c.param_bytes:
+            bad(f"all-reduce result bytes {by.get('all-reduce', 0)} < "
+                f"total param bytes {c.param_bytes}")
+        return out
+
+    bad(f"unknown strategy {c.strategy!r} in contract")
+    return out
+
+
+def _result_dtype(ins: hlo_ir.Instruction) -> Optional[str]:
+    m = stats._SHAPE_RE.search(ins.result_type)
+    return m.group(1) if m else None
+
+
+def _rule_dtype_leak(module: hlo_ir.Module, jaxpr,
+                     c: ProgramContract) -> List[Finding]:
+    if c.precision != "bf16":
+        return []
+    out = []
+    for ins in module.instructions():
+        if ins.opcode in ("dot", "convolution") and \
+                _result_dtype(ins) in ("f32", "f64"):
+            out.append(Finding(
+                "dtype-leak", c.name,
+                f"{_result_dtype(ins)} {ins.opcode} {ins.name!r} in a "
+                f"bf16-declared program (silent promotion doubles MXU "
+                f"cost): {ins.result_type}"))
+    return out
+
+
+def _rule_donation(module: hlo_ir.Module, jaxpr,
+                   c: ProgramContract) -> List[Finding]:
+    if not c.donates_state:
+        return []
+    n = module.donated_param_count()
+    if n < c.n_state_leaves:
+        return [Finding(
+            "donation", c.name,
+            f"declared to donate the train state but only {n} of >= "
+            f"{c.n_state_leaves} entry buffers are donated "
+            f"(buffer_donor/input_output_alias) — un-donated state "
+            f"doubles peak HBM")]
+    return []
+
+
+def _while_reachable(module: hlo_ir.Module) -> set:
+    """Names of computations reachable from any ``while`` body/condition."""
+    seeds = []
+    for ins in module.instructions():
+        if ins.opcode == "while":
+            seeds.extend(ins.called)
+    seen = set()
+    stack = list(seeds)
+    while stack:
+        name = stack.pop()
+        if name in seen or name not in module.computations:
+            continue
+        seen.add(name)
+        for ins in module.computations[name].instructions.values():
+            stack.extend(ins.called)
+    return seen
+
+
+def _jaxpr_host_syncs(jaxpr, in_loop: bool = False) -> List[str]:
+    hits: List[str] = []
+    for eqn in getattr(jaxpr, "eqns", ()):
+        prim = eqn.primitive.name
+        inner_loop = in_loop or prim in _LOOP_PRIMITIVES
+        if in_loop and ("callback" in prim or prim in ("infeed", "outfeed")):
+            hits.append(prim)
+        for v in eqn.params.values():
+            for x in (v if isinstance(v, (list, tuple)) else (v,)):
+                sub = getattr(x, "jaxpr", x)
+                if hasattr(sub, "eqns"):
+                    hits.extend(_jaxpr_host_syncs(sub, inner_loop))
+    return hits
+
+
+def _rule_host_sync(module: hlo_ir.Module, jaxpr,
+                    c: ProgramContract) -> List[Finding]:
+    out: List[Finding] = []
+    loop_comps = _while_reachable(module)
+    for cname in loop_comps:
+        for ins in module.computations[cname].instructions.values():
+            target = ins.attr("custom_call_target") or ""
+            if ins.opcode in _HOST_SYNC_OPS or (
+                    ins.opcode == "custom-call"
+                    and _CALLBACK_TARGET_RE.search(target)):
+                out.append(Finding(
+                    "host-sync", c.name,
+                    f"host sync {ins.opcode} {ins.name!r}"
+                    f"{' -> ' + target if target else ''} inside loop "
+                    f"computation {cname!r}: one host round-trip per "
+                    f"scanned step serializes the window"))
+    if jaxpr is not None:
+        for prim in _jaxpr_host_syncs(getattr(jaxpr, "jaxpr", jaxpr)):
+            out.append(Finding(
+                "host-sync", c.name,
+                f"callback primitive {prim!r} inside a scan/while body "
+                f"(jaxpr)"))
+    return out
+
+
+def _rule_baked_constants(module: hlo_ir.Module, jaxpr,
+                          c: ProgramContract) -> List[Finding]:
+    out = []
+    for ins in module.instructions():
+        if ins.opcode != "constant":
+            continue
+        b = stats.bytes_of_type(ins.result_type)
+        if b > c.max_constant_bytes:
+            out.append(Finding(
+                "baked-constants", c.name,
+                f"constant {ins.name!r} bakes {b} bytes "
+                f"({ins.result_type}) into the executable "
+                f"(> {c.max_constant_bytes}); pass it as an argument"))
+    return out
+
+
+RULES = {
+    "collective-contract": _rule_collective_contract,
+    "dtype-leak": _rule_dtype_leak,
+    "donation": _rule_donation,
+    "host-sync": _rule_host_sync,
+    "baked-constants": _rule_baked_constants,
+}
+
+
+def audit_program(hlo_text: str, contract: ProgramContract, jaxpr=None,
+                  waive: Sequence[str] = ()) -> AuditReport:
+    """Run every rule over one program's lowering (+ optional jaxpr)."""
+    module = hlo_ir.parse(hlo_text)
+    report = AuditReport(program=contract.name)
+    s = stats.collective_stats(module)
+    report.stats = {
+        "collectives": {op: e["count"] for op, e in s["ops"].items()},
+        "chain_depth": stats.collective_chain_depth(module),
+        "donated": module.donated_param_count(),
+    }
+    for rule, fn in RULES.items():
+        findings = fn(module, jaxpr, contract)
+        kept = [f for f in findings if not _waived(f, waive)]
+        dropped = [f for f in findings if _waived(f, waive)]
+        report.findings.extend(kept)
+        report.waived.extend(dropped)
+        report.rules[rule] = ("fail" if kept else
+                              "waived" if dropped else "pass")
+    return report
+
+
+# ---------------------------------------------------------------------------
+# The program zoo: every shipped program, lowered and audited
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AuditResult:
+    reports: List[AuditReport] = field(default_factory=list)
+    ladder: Dict = field(default_factory=dict)
+    ladder_findings: List[Finding] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return (not self.ladder_findings
+                and all(r.passed for r in self.reports))
+
+    def findings(self) -> List[Finding]:
+        out = [f for r in self.reports for f in r.findings]
+        out.extend(self.ladder_findings)
+        return out
+
+    def waived(self) -> List[Finding]:
+        return [f for r in self.reports for f in r.waived]
+
+    def summary(self) -> Dict:
+        """Manifest/bench-ready record: per-program rule pass/fail +
+        waivers, the strategy depth ladder, and every finding message."""
+        return {
+            "clean": self.clean,
+            "n_programs": len(self.reports),
+            "n_findings": len(self.findings()),
+            "n_waived": len(self.waived()),
+            "programs": {
+                r.program: {"rules": r.rules, **r.stats}
+                for r in self.reports},
+            "findings": [
+                {"rule": f.rule, "program": f.program,
+                 "message": f.message[:300]}
+                for f in self.findings()],
+            "waived": [
+                {"rule": f.rule, "program": f.program,
+                 "message": f.message[:300]}
+                for f in self.waived()],
+            **({"ladder": self.ladder} if self.ladder else {}),
+        }
+
+    def format_lines(self) -> List[str]:
+        lines = []
+        for r in self.reports:
+            mark = "PASS" if r.passed else "FAIL"
+            extra = f"  waived={len(r.waived)}" if r.waived else ""
+            lines.append(f"[audit] {mark} {r.program}  "
+                         f"collectives={r.stats.get('collectives', {})} "
+                         f"depth={r.stats.get('chain_depth')}{extra}")
+            for f in r.findings + r.waived:
+                tag = "waived " if f in r.waived else ""
+                lines.append(f"[audit]   {tag}{f.rule}: {f.message}")
+        for f in self.ladder_findings:
+            lines.append(f"[audit] FAIL {f.program} {f.rule}: {f.message}")
+        if self.ladder:
+            lines.append(f"[audit] strategy depth ladder: {self.ladder}")
+        lines.append(f"[audit] {'CLEAN' if self.clean else 'DIRTY'}: "
+                     f"{len(self.reports)} programs, "
+                     f"{len(self.findings())} findings, "
+                     f"{len(self.waived())} waived")
+        return lines
+
+
+def _certify_ladder(depths: Dict[str, int], nleaves: int, nbuckets: int,
+                    program: str) -> Tuple[Dict, List[Finding]]:
+    """Cross-strategy certification: the paper's ordering of chain depths
+    (bucketed ddp < per-param allreduce < chained gather) must hold on
+    the lowered programs themselves whenever several tiers are audited
+    together on a multi-device mesh."""
+    ladder = dict(depths)
+    findings: List[Finding] = []
+
+    def bad(msg):
+        findings.append(Finding("collective-contract", program, msg))
+
+    if "allreduce" in depths and "gather" in depths:
+        if not depths["gather"] > depths["allreduce"]:
+            bad(f"gather depth {depths['gather']} must exceed allreduce "
+                f"depth {depths['allreduce']} (two chained collectives "
+                f"per leaf vs one)")
+    if "allreduce" in depths and "ddp" in depths and nleaves > nbuckets:
+        if not depths["ddp"] < depths["allreduce"]:
+            bad(f"ddp depth {depths['ddp']} must be shallower than "
+                f"allreduce depth {depths['allreduce']} with {nbuckets} "
+                f"buckets over {nleaves} leaves")
+    return ladder, findings
+
+
+def _train_sds(mesh, state_sds, global_batch: int, window: int):
+    """ShapeDtypeStructs for the train step/window/eval signatures on
+    ``mesh`` (mirrors the Trainer's staging shapes)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P("data"))
+    epoch = NamedSharding(mesh, P(None, "data"))
+
+    def share(sds, sharding):
+        return jax.ShapeDtypeStruct(sds.shape, sds.dtype, sharding=sharding)
+
+    state = jax.tree_util.tree_map(lambda s: share(s, rep), state_sds)
+    b, w = global_batch, window
+    return {
+        "state": state,
+        "key": jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep),
+        "images": jax.ShapeDtypeStruct((b, 32, 32, 3), jnp.uint8,
+                                       sharding=row),
+        "labels": jax.ShapeDtypeStruct((b,), jnp.int32, sharding=row),
+        "epoch_images": jax.ShapeDtypeStruct((w, b, 32, 32, 3), jnp.uint8,
+                                             sharding=epoch),
+        "epoch_labels": jax.ShapeDtypeStruct((w, b), jnp.int32,
+                                             sharding=epoch),
+        "start": jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
+        "lengths": jax.ShapeDtypeStruct((w,), jnp.int8, sharding=rep),
+    }
+
+
+def _hlo_text(lowered) -> str:
+    return lowered.compiler_ir(dialect="hlo").as_hlo_text()
+
+
+def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
+              window: int = 4, precision: str = "f32",
+              strategies: Sequence[str] = ("single", "gather",
+                                           "allreduce", "ddp"),
+              paths: Sequence[str] = ("step", "window", "host_window"),
+              include_eval: bool = True,
+              serve_buckets: Sequence[int] = (),
+              serve_precision: Optional[str] = None,
+              num_devices: Optional[int] = None,
+              waive: Sequence[str] = (),
+              max_constant_bytes: int = DEFAULT_MAX_CONSTANT_BYTES,
+              ) -> AuditResult:
+    """Lower and audit the shipped program zoo: the 3 train paths for
+    each strategy, the eval window, and (when ``serve_buckets`` is
+    non-empty) the serving executable ladder.
+
+    Lowering is ABSTRACT end to end — train state shapes come from
+    ``jax.eval_shape`` so no parameters are materialized; only the
+    serving entries (which reuse :class:`serve.InferenceEngine`)
+    initialize real weights.
+    """
+    import jax
+
+    from ..models import get_model
+    from ..ops import sgd
+    from ..parallel import get_strategy, mesh as meshlib
+    from ..parallel.bucketing import DEFAULT_BUCKET_BYTES, make_plan
+    from ..train import step as steplib
+
+    import jax.numpy as jnp
+
+    compute_dtype = jnp.bfloat16 if precision == "bf16" else None
+    init_fn, apply_fn = get_model(model)
+    state_sds = jax.eval_shape(
+        lambda k: steplib.init_train_state(init_fn, k),
+        jax.random.PRNGKey(0))
+    params_sds = state_sds.params
+    nleaves = len(jax.tree_util.tree_leaves(params_sds))
+    n_state_leaves = len(jax.tree_util.tree_leaves(state_sds))
+    param_bytes = sum(l.size * l.dtype.itemsize
+                      for l in jax.tree_util.tree_leaves(params_sds))
+    nbuckets = make_plan(params_sds, DEFAULT_BUCKET_BYTES).num_buckets
+
+    full_mesh = meshlib.make_mesh(num_devices)
+    single_mesh = meshlib.make_mesh(1)
+    world = full_mesh.devices.size
+    sgd_cfg = sgd.SGDConfig()
+    result = AuditResult()
+    window_depths: Dict[str, int] = {}
+
+    def contract(name, strategy, w, donates):
+        return ProgramContract(
+            name=name, strategy=strategy, world=w, nleaves=nleaves,
+            nbuckets=nbuckets, param_bytes=param_bytes,
+            n_state_leaves=n_state_leaves, donates_state=donates,
+            precision=precision, max_constant_bytes=max_constant_bytes)
+
+    for strategy in strategies:
+        mesh = single_mesh if strategy == "single" else full_mesh
+        w = mesh.devices.size
+        b = max(w, (global_batch // w) * w)
+        sds = _train_sds(mesh, state_sds, b, window)
+        strat = get_strategy(strategy)
+        for path in paths:
+            name = f"train/{path}/{strategy}"
+            if path == "step":
+                fn = steplib.make_train_step(
+                    apply_fn, strat, mesh, sgd_cfg, augment=True,
+                    compute_dtype=compute_dtype)
+                args = (sds["state"], sds["key"], sds["images"],
+                        sds["labels"])
+                donates = False
+            else:
+                fn = steplib.make_train_window(
+                    apply_fn, strat, mesh, sgd_cfg,
+                    augment=(path == "window"), compute_dtype=compute_dtype)
+                args = (sds["state"], sds["key"], sds["epoch_images"],
+                        sds["epoch_labels"], sds["start"], sds["lengths"])
+                donates = True
+            text = _hlo_text(fn.lower(*args))
+            jaxpr = (jax.make_jaxpr(fn)(*args)
+                     if path == "window" else None)
+            result.reports.append(audit_program(
+                text, contract(name, strategy, w, donates), jaxpr,
+                waive=waive))
+            if path == "window":
+                window_depths[strategy] = \
+                    result.reports[-1].stats["chain_depth"]
+
+    if include_eval:
+        sds = _train_sds(full_mesh, state_sds,
+                         max(world, (global_batch // world) * world),
+                         window)
+        ev = steplib.make_eval_window(apply_fn, full_mesh,
+                                      compute_dtype=compute_dtype)
+        args = (sds["state"], sds["epoch_images"], sds["epoch_labels"])
+        text = _hlo_text(ev.lower(*args))
+        result.reports.append(audit_program(
+            text, contract("eval/window", "eval", world, False),
+            jax.make_jaxpr(ev)(*args), waive=waive))
+
+    if serve_buckets:
+        result.reports.extend(audit_serving(
+            model=model, buckets=serve_buckets,
+            precision=serve_precision or precision, waive=waive,
+            max_constant_bytes=max_constant_bytes))
+
+    if world > 1 and len(window_depths) > 1:
+        result.ladder, result.ladder_findings = _certify_ladder(
+            window_depths, nleaves, nbuckets,
+            program="strategy-ladder(train/window)")
+        kept = [f for f in result.ladder_findings
+                if not _waived(f, waive)]
+        result.ladder_findings = kept
+    return result
+
+
+def audit_serving(*, model: str = "vgg11",
+                  buckets: Sequence[int] = (1, 8, 32, 128, 256),
+                  precision: str = "f32", engine=None,
+                  waive: Sequence[str] = (),
+                  max_constant_bytes: int = DEFAULT_MAX_CONSTANT_BYTES,
+                  ) -> List[AuditReport]:
+    """Audit the serving executable ladder: one single-device program per
+    bucket, required collective-free, precision-certified, constant-lean.
+    Pass ``engine`` to audit an already-built :class:`InferenceEngine`
+    (the bench serving section does); otherwise one is built without
+    staging or caches."""
+    if engine is None:
+        from ..serve import InferenceEngine
+        engine = InferenceEngine(model, buckets=tuple(buckets),
+                                 precisions=(precision,),
+                                 use_staging=False,
+                                 enable_compilation_cache=False)
+    reports = []
+    for b in engine.buckets:
+        c = ProgramContract(
+            name=f"serve/b{b}/{precision}", strategy=None, world=1,
+            precision=precision, max_constant_bytes=max_constant_bytes)
+        reports.append(audit_program(
+            engine.lowered_hlo(b, precision), c, waive=waive))
+    return reports
+
+
+def record_audit(telemetry, result: AuditResult) -> None:
+    """Attach the audit summary to the run manifest.  The disabled
+    recorder path allocates and touches NOTHING (exploding-recorder
+    pinned in tests/test_analysis.py)."""
+    if not getattr(telemetry, "enabled", False):
+        return
+    telemetry.update_manifest({"audit": result.summary()})

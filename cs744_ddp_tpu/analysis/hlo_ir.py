@@ -1,0 +1,378 @@
+"""Tokenizer + parser for HLO text producing a small graph IR.
+
+XLA prints a module in two dialects and this parser accepts both:
+
+- the OPTIMIZED print — ``%``-sigils on every name, operands carry their
+  types (``f32[64,10]{1,0} %fusion.1``), layouts/tiling in braces
+  (``{1,0:T(8,128)S(1)}``), ``metadata={...}`` trailers;
+- the PRE-OPTIMIZATION print (``lowered.compiler_ir(dialect="hlo")``) —
+  bare names, untyped operands, no layouts.
+
+The previous approach (``utils/hlo_stats.py``) ran regexes over raw lines
+and was print-format-sensitive: a quoted brace inside ``source_file`` or a
+``metadata op_name`` colliding with an instruction name historically
+poisoned the dependency graph, each patched with one more regex.  Here the
+text is scanned character-wise with bracket- and string-awareness, so
+attributes, operands and called computations are STRUCTURAL fields, not
+token soup; downstream analyses (``analysis/stats.py``, ``analysis/audit``)
+never see a string literal or a metadata block unless they ask for it.
+
+The IR is deliberately small: a :class:`Module` holds header attributes
+(``buffer_donor``/``input_output_alias`` feed the donation audit) and
+ordered :class:`Computation`\\ s; each computation holds ordered
+:class:`Instruction`\\ s with opcode, result type, operand names, attribute
+list and called-computation names.  ``Module.to_text()`` reprints the
+parse, and ``parse(to_text(parse(x)))`` is structurally identical —
+pinned by tests/test_analysis.py's round-trip test.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+# Attribute keys whose values name other computations in the module.
+CALLED_ATTRS = ("to_apply", "body", "condition", "calls",
+                "branch_computations", "called_computations",
+                "computations")
+
+_IDENT_RE = re.compile(r"[%A-Za-z_][\w.\-]*")
+_NAME_AT_END_RE = re.compile(r"(%?[\w.\-]+)\s*$")
+_OPCODE_RE = re.compile(r"[a-z][\w\-]*")
+# Computation header: `%name (params) -> type {` (optimized) or the bare
+# pre-optimization `name {`; `ENTRY`-prefixed for the entry computation.
+_COMP_HEAD_RE = re.compile(
+    r"^\s*(?:ENTRY\s+)?(?P<name>%?[\w.\-]+)\s*(?:\([^)]*\))?"
+    r"\s*(?:->\s*[^{]*)?\{\s*$")
+_INSTR_RE = re.compile(
+    r"^\s*(?P<root>ROOT\s+)?(?P<name>%?[\w.\-]+)\s*=\s*(?P<rhs>.+)$")
+
+_OPEN = {"(": ")", "[": "]", "{": "}"}
+_CLOSE = {")", "]", "}"}
+
+
+class HloParseError(ValueError):
+    pass
+
+
+def _scan_string(s: str, i: int) -> int:
+    """``s[i]`` is ``\"``; return the index just past the closing quote,
+    honouring backslash escapes."""
+    i += 1
+    while i < len(s):
+        c = s[i]
+        if c == "\\":
+            i += 2
+            continue
+        if c == '"':
+            return i + 1
+        i += 1
+    return len(s)  # unterminated: tolerate, consume to end
+
+
+def _scan_balanced(s: str, i: int) -> int:
+    """``s[i]`` is an opening bracket; return the index just past its
+    matching close, skipping strings and nested brackets of any kind
+    (layout annotations like ``{1,0:T(8,128)S(1)}`` nest parens in
+    braces)."""
+    depth = 0
+    while i < len(s):
+        c = s[i]
+        if c == '"':
+            i = _scan_string(s, i)
+            continue
+        if c in _OPEN:
+            depth += 1
+        elif c in _CLOSE:
+            depth -= 1
+            if depth == 0:
+                return i + 1
+        i += 1
+    return len(s)
+
+
+def split_top(s: str, sep: str = ",") -> List[str]:
+    """Split ``s`` at top-level occurrences of ``sep`` (outside every
+    bracket pair and string literal)."""
+    parts: List[str] = []
+    buf_start = 0
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == '"':
+            i = _scan_string(s, i)
+            continue
+        if c in _OPEN:
+            i = _scan_balanced(s, i)
+            continue
+        if c == sep:
+            parts.append(s[buf_start:i])
+            buf_start = i + 1
+        i += 1
+    parts.append(s[buf_start:])
+    return parts
+
+
+@dataclass
+class Instruction:
+    name: str                              # sigil-stripped
+    opcode: str
+    result_type: str                       # "" when the print omits it
+    operands: Tuple[str, ...]              # referenced value names, stripped
+    operand_raw: Tuple[str, ...]           # operand text as printed
+    attrs: Tuple[Tuple[str, str], ...]     # ordered (key, raw value)
+    is_root: bool = False
+    sigil: bool = False                    # name printed with '%'
+    line_no: int = 0
+
+    def attr(self, key: str) -> Optional[str]:
+        for k, v in self.attrs:
+            if k == key:
+                return v
+        return None
+
+    @property
+    def called(self) -> Tuple[str, ...]:
+        """Names of computations this instruction invokes (while bodies,
+        reducers, fusion/call targets, conditional branches)."""
+        out: List[str] = []
+        for key in CALLED_ATTRS:
+            raw = self.attr(key)
+            if not raw:
+                continue
+            for tok in _IDENT_RE.findall(raw):
+                out.append(tok.lstrip("%"))
+        return tuple(out)
+
+    def to_text(self) -> str:
+        head = "ROOT " if self.is_root else ""
+        name = ("%" + self.name) if self.sigil else self.name
+        rtype = (self.result_type + " ") if self.result_type else ""
+        ops = ", ".join(self.operand_raw)
+        attrs = "".join(
+            f", {k}={v}" if v is not None else f", {k}"
+            for k, v in self.attrs)
+        return f"{head}{name} = {rtype}{self.opcode}({ops}){attrs}"
+
+
+@dataclass
+class Computation:
+    name: str                              # sigil-stripped
+    header: str                            # header line as printed (sans indent)
+    is_entry: bool = False
+    instructions: Dict[str, Instruction] = field(default_factory=dict)
+
+    @property
+    def root(self) -> Optional[Instruction]:
+        root = None
+        for ins in self.instructions.values():
+            if ins.is_root:
+                return ins
+            root = ins                     # fall back to the last def
+        return root
+
+    def to_text(self) -> str:
+        lines = [("ENTRY " if self.is_entry else "") + self.header]
+        for ins in self.instructions.values():
+            lines.append("  " + ins.to_text())
+        lines.append("}")
+        return "\n".join(lines)
+
+
+@dataclass
+class Module:
+    name: str = ""
+    attrs: Tuple[Tuple[str, str], ...] = ()
+    computations: Dict[str, Computation] = field(default_factory=dict)
+    entry: Optional[str] = None
+
+    def attr(self, key: str) -> Optional[str]:
+        for k, v in self.attrs:
+            if k == key:
+                return v
+        return None
+
+    def instructions(self) -> Iterator[Instruction]:
+        for comp in self.computations.values():
+            yield from comp.instructions.values()
+
+    @property
+    def entry_computation(self) -> Optional[Computation]:
+        if self.entry is not None:
+            return self.computations.get(self.entry)
+        return next(iter(self.computations.values()), None)
+
+    def donated_param_count(self) -> int:
+        """Number of donated entry parameters, from whichever header form
+        this toolchain prints: ``buffer_donor={ (0, {}), ... }`` (the
+        pre-optimization print of ``donate_argnums``) or
+        ``input_output_alias={ {0}: (0, {}, may-alias), ... }``."""
+        n = 0
+        for key in ("buffer_donor", "input_output_alias"):
+            raw = self.attr(key)
+            if raw:
+                n = max(n, len(re.findall(r"\(\s*\d+\s*,", raw)))
+        return n
+
+    def to_text(self) -> str:
+        attrs = "".join(
+            f", {k}={v}" if v is not None else f", {k}"
+            for k, v in self.attrs)
+        out = [f"HloModule {self.name}{attrs}", ""]
+        for comp in self.computations.values():
+            out.append(comp.to_text())
+            out.append("")
+        return "\n".join(out)
+
+
+def _parse_attr_list(s: str) -> Tuple[Tuple[str, str], ...]:
+    attrs: List[Tuple[str, str]] = []
+    for item in split_top(s):
+        item = item.strip()
+        if not item:
+            continue
+        eq = _top_level_eq(item)
+        if eq < 0:
+            attrs.append((item, None))
+        else:
+            attrs.append((item[:eq].strip(), item[eq + 1:].strip()))
+    return tuple(attrs)
+
+
+def _top_level_eq(s: str) -> int:
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == '"':
+            i = _scan_string(s, i)
+            continue
+        if c in _OPEN:
+            i = _scan_balanced(s, i)
+            continue
+        if c == "=":
+            return i
+        i += 1
+    return -1
+
+
+def _parse_type(rhs: str, i: int) -> Tuple[str, int]:
+    """Parse a result type starting at ``rhs[i]``; returns (type, next).
+    Types are either a parenthesized tuple or ``dtype[dims]`` with an
+    optional layout ``{...}``; returns ("", i) when ``rhs[i]`` does not
+    start a type (some prints omit the result type entirely)."""
+    start = i
+    if i < len(rhs) and rhs[i] == "(":
+        j = _scan_balanced(rhs, i)
+        return rhs[start:j], j
+    m = _OPCODE_RE.match(rhs, i) or _IDENT_RE.match(rhs, i)
+    if not m or m.end() >= len(rhs) or rhs[m.end()] != "[":
+        return "", i
+    j = _scan_balanced(rhs, m.end())
+    if j < len(rhs) and rhs[j] == "{":          # layout annotation
+        j = _scan_balanced(rhs, j)
+    return rhs[start:j], j
+
+
+def _parse_operand(raw: str) -> Optional[str]:
+    """Referenced value name of one operand: the final identifier token
+    (the optimized print prefixes the name with its type)."""
+    m = _NAME_AT_END_RE.search(raw.strip())
+    if not m:
+        return None
+    return m.group(1).lstrip("%")
+
+
+def _parse_rhs(rhs: str) -> Tuple[str, str, List[str], List[str],
+                                  Tuple[Tuple[str, str], ...]]:
+    """``rhs`` of an instruction -> (result_type, opcode, operand names,
+    operand raw texts, attrs)."""
+    rhs = rhs.strip()
+    rtype, i = _parse_type(rhs, 0)
+    while i < len(rhs) and rhs[i].isspace():
+        i += 1
+    m = _OPCODE_RE.match(rhs, i)
+    if not m or m.end() >= len(rhs) or rhs[m.end()] != "(":
+        raise HloParseError(f"no opcode in instruction RHS: {rhs[:120]!r}")
+    opcode = m.group(0)
+    j = _scan_balanced(rhs, m.end())
+    operand_text = rhs[m.end() + 1:j - 1]
+    operands: List[str] = []
+    operand_raw: List[str] = []
+    for part in split_top(operand_text):
+        part = part.strip()
+        if not part:
+            continue
+        operand_raw.append(part)
+        name = _parse_operand(part)
+        if name is not None:
+            operands.append(name)
+    rest = rhs[j:].strip()
+    if rest.startswith(","):
+        rest = rest[1:]
+    return rtype, opcode, operands, operand_raw, _parse_attr_list(rest)
+
+
+def parse(hlo_text: str) -> Module:
+    """Parse an HLO module print (either dialect) into a :class:`Module`."""
+    mod = Module()
+    cur: Optional[Computation] = None
+    for line_no, line in enumerate(hlo_text.splitlines(), 1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith(("//", "#")):
+            continue
+        if stripped.startswith("HloModule"):
+            rest = stripped[len("HloModule"):].strip()
+            parts = split_top(rest)
+            mod.name = parts[0].strip()
+            mod.attrs = _parse_attr_list(",".join(parts[1:]))
+            continue
+        if stripped == "}":
+            cur = None
+            continue
+        head = _COMP_HEAD_RE.match(line)
+        if (not head and stripped.endswith("{") and "=" not in line
+                and not stripped.startswith(("while", "if", "for"))):
+            # Headers whose param types carry layout annotations nest
+            # parens inside the param list and escape the simple regex;
+            # any `name (...){` line without `=` is still a header.
+            body = stripped[len("ENTRY"):].strip() \
+                if stripped.startswith("ENTRY ") else stripped
+            first = _IDENT_RE.match(body)
+            if first:
+                head = _COMP_HEAD_RE.match(
+                    ("ENTRY " if stripped.startswith("ENTRY ") else "")
+                    + first.group(0) + " {")
+        if (head and stripped.endswith("{") and "=" not in line):
+            name = head.group("name").lstrip("%")
+            cur = Computation(name=name, header=stripped,
+                              is_entry=stripped.startswith("ENTRY") or
+                              line.lstrip().startswith("ENTRY"))
+            if cur.is_entry:
+                cur.header = stripped[len("ENTRY"):].strip()
+                mod.entry = name
+            mod.computations[name] = cur
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        if cur is None:
+            # Instructions with no enclosing computation header (snippet
+            # inputs, hand-written samples): collect them in an implicit
+            # computation.  The `$` keeps the name un-referenceable.
+            cur = mod.computations.setdefault(
+                "$toplevel", Computation(name="$toplevel",
+                                         header="$toplevel {"))
+        try:
+            rtype, opcode, operands, op_raw, attrs = _parse_rhs(
+                m.group("rhs"))
+        except HloParseError:
+            continue                       # non-instruction noise line
+        raw_name = m.group("name")
+        ins = Instruction(
+            name=raw_name.lstrip("%"), opcode=opcode, result_type=rtype,
+            operands=tuple(operands), operand_raw=tuple(op_raw),
+            attrs=attrs, is_root=bool(m.group("root")),
+            sigil=raw_name.startswith("%"), line_no=line_no)
+        cur.instructions[ins.name] = ins
+    return mod

@@ -1,0 +1,161 @@
+"""Collective-op accounting over the :mod:`analysis.hlo_ir` graph IR.
+
+Same public API and conventions as the historical regex implementation
+(``utils/hlo_stats.py``, now a thin adapter over this module — its regex
+code survives as ``legacy_*`` oracles for the differential test):
+
+- Byte accounting sums RESULT buffer sizes (tuple elements included): an
+  all-gather result is world x the input, which is exactly the gather
+  tier's traffic amplification.
+- Async pairs are counted once: the ``-start`` op contributes the
+  instance count (its result tuple also carries source buffers and would
+  overcount bytes), the ``-done`` op contributes the result bytes.
+- ``collective_chain_depth`` wants the PRE-OPTIMIZATION print
+  (``lowered.compiler_ir(dialect="hlo").as_hlo_text()``), where the
+  strategies' ``optimization_barrier`` chains are still data
+  dependencies.  Operand chains and called-computation internals COMPOSE
+  (sum, not max): a collective chain feeding a collective-bearing while
+  body sits at chain + body depth.
+
+Every function accepts either raw HLO text or an already-parsed
+:class:`~cs744_ddp_tpu.analysis.hlo_ir.Module`, so audit rules that
+share one parse don't re-tokenize per rule.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Union
+
+from . import hlo_ir
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+COLLECTIVE_BASES = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+_COLLECTIVE_OPS = frozenset(
+    base + suffix for base in COLLECTIVE_BASES
+    for suffix in ("", "-start", "-done"))
+
+ModuleOrText = Union[str, hlo_ir.Module]
+
+
+def _as_module(hlo: ModuleOrText) -> hlo_ir.Module:
+    return hlo if isinstance(hlo, hlo_ir.Module) else hlo_ir.parse(hlo)
+
+
+def bytes_of_type(type_str: str) -> int:
+    """Total bytes of every ``dtype[dims]`` shape in an HLO result type
+    (a bare shape or a tuple; layout/tiling annotations are ignored)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        if dtype not in _DTYPE_BYTES:
+            continue  # e.g. token[] / opaque[]
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_base(opcode: str) -> Union[str, None]:
+    """Base collective name for ``opcode`` (async suffixes stripped), or
+    None when the opcode is not a collective."""
+    if opcode not in _COLLECTIVE_OPS:
+        return None
+    return re.sub(r"-(start|done)$", "", opcode)
+
+
+def collective_weight(opcode: str) -> int:
+    """1 for a collective instruction (async start/done pairs counted
+    once, on the start), else 0."""
+    if opcode.endswith("-done"):
+        return 0
+    return int(re.sub(r"-start$", "", opcode) in COLLECTIVE_BASES)
+
+
+def collective_stats(hlo: ModuleOrText) -> Dict:
+    """{"ops": {op: {"count", "result_mib"}}, "total_count",
+    "total_result_mib"} over every collective instruction in the module."""
+    module = _as_module(hlo)
+    ops: Dict[str, Dict[str, float]] = {}
+    for ins in module.instructions():
+        base = collective_base(ins.opcode)
+        if base is None:
+            continue
+        entry = ops.setdefault(base, {"count": 0, "result_mib": 0.0})
+        if not ins.opcode.endswith("-done"):
+            entry["count"] += 1
+        if not ins.opcode.endswith("-start"):
+            entry["result_mib"] += bytes_of_type(ins.result_type) / 2**20
+    for entry in ops.values():
+        entry["result_mib"] = round(entry["result_mib"], 2)
+    return {
+        "ops": ops,
+        "total_count": sum(e["count"] for e in ops.values()),
+        "total_result_mib": round(
+            sum(e["result_mib"] for e in ops.values()), 2),
+    }
+
+
+def collective_bytes(hlo: ModuleOrText) -> Dict[str, int]:
+    """Exact (un-rounded) result bytes per collective base op — what the
+    audit's byte contracts compare against parameter sizes; the MiB
+    rounding in :func:`collective_stats` zeroes out small test models."""
+    module = _as_module(hlo)
+    out: Dict[str, int] = {}
+    for ins in module.instructions():
+        base = collective_base(ins.opcode)
+        if base is None or ins.opcode.endswith("-start"):
+            continue
+        out[base] = out.get(base, 0) + bytes_of_type(ins.result_type)
+    return out
+
+
+def collective_chain_depth(hlo: ModuleOrText) -> int:
+    """Longest dependency chain of collectives in the module: the number
+    of collectives that must execute SEQUENTIALLY (each consuming a value
+    the previous produced), regardless of how many run in total.
+
+    This is the latency SHAPE of a gradient-sync tier, statically: the
+    gather tier chains two dependent collectives per parameter leaf
+    behind a barrier chain, the per-param all-reduce tier one per leaf,
+    the bucketed ddp tier one per bucket.  Computed per computation over
+    the SSA def-use graph; operand chains and called-computation
+    internals compose by SUM (see module docstring)."""
+    module = _as_module(hlo)
+    comp_depth: Dict[str, int] = {}
+
+    def depth_of_comp(cname: str, stack=()) -> int:
+        if cname in comp_depth:
+            return comp_depth[cname]
+        if cname in stack:   # recursive reference (shouldn't happen)
+            return 0
+        comp = module.computations.get(cname)
+        d: Dict[str, int] = {}
+        best = 0
+        if comp is not None:
+            for ins in comp.instructions.values():
+                operand_chain = 0
+                for r in ins.operands:
+                    if r in d:
+                        operand_chain = max(operand_chain, d[r])
+                callee_depth = 0
+                for c in ins.called:
+                    if c in module.computations and c != cname:
+                        callee_depth = max(
+                            callee_depth,
+                            depth_of_comp(c, stack + (cname,)))
+                d[ins.name] = (collective_weight(ins.opcode)
+                               + operand_chain + callee_depth)
+                best = max(best, d[ins.name])
+        comp_depth[cname] = best
+        return best
+
+    return max((depth_of_comp(c) for c in module.computations), default=0)

@@ -166,6 +166,30 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--serve-seed", type=int, default=0,
                     help="seed for the synthetic request trace AND the "
                          "demo model init")
+    au = p.add_argument_group(
+        "static analysis (analysis/)",
+        "HLO/jaxpr program audit: certify each compiled program's cost "
+        "shape (collective contract per strategy, dtype leaks, donation "
+        "misses, host syncs in loop bodies, baked constants) before any "
+        "step runs; results land in the telemetry manifest")
+    au.add_argument("--audit", default="off",
+                    choices=["off", "warn", "strict"],
+                    help="audit the programs this run will dispatch "
+                         "(train: the configured strategy's step/window/"
+                         "host-window + eval; serve: the bucket ladder). "
+                         "warn prints findings and continues; strict "
+                         "exits 2 on any unwaived finding")
+    au.add_argument("--audit-zoo", action="store_true",
+                    help="audit the FULL program zoo (all 4 strategies x "
+                         "3 train paths, eval, the serving ladder at "
+                         "--serve-buckets) and exit without training; "
+                         "combine with --audit strict for the CI gate")
+    au.add_argument("--audit-waive", action="append", default=None,
+                    metavar="RULE[@GLOB]",
+                    help="waive an audit rule, optionally only for "
+                         "programs matching a glob, e.g. "
+                         "baked-constants@serve/* (repeatable); waived "
+                         "findings are reported but don't fail strict")
     return p
 
 
@@ -188,6 +212,33 @@ def ft_config_from_args(args) -> "FTConfig | None":
     )
 
 
+def _apply_audit(args, telemetry, result) -> None:
+    """Shared --audit plumbing: print the report, record it in the run
+    manifest (enabled recorders only — see analysis.audit.record_audit),
+    exit 2 under strict when any unwaived finding remains."""
+    from .analysis import audit as auditlib
+
+    for line in result.format_lines():
+        print(line)
+    auditlib.record_audit(telemetry, result)
+    if args.audit == "strict" and not result.clean:
+        raise SystemExit(2)
+
+
+def audit_main(args, telemetry) -> None:
+    """--audit-zoo: certify the full shipped-program matrix and exit."""
+    from .analysis import audit as auditlib
+    from .serve import demo
+
+    result = auditlib.audit_zoo(
+        model=args.model, global_batch=args.batch_size,
+        precision=args.precision,
+        serve_buckets=demo.parse_buckets(args.serve_buckets),
+        serve_precision=args.serve_precision,
+        num_devices=args.num_devices, waive=args.audit_waive or ())
+    _apply_audit(args, telemetry, result)
+
+
 def serve_main(args, telemetry) -> None:
     """--serve-demo: build the ladder, replay the seeded trace at each
     offered load, print ONE JSON line (startup report + per-load stats)."""
@@ -206,6 +257,12 @@ def serve_main(args, telemetry) -> None:
         "max_wait_ms": args.serve_max_wait_ms,
         "requests": args.serve_requests, "seed": args.serve_seed,
     })
+    if args.audit != "off":
+        from .analysis import audit as auditlib
+        result = auditlib.AuditResult(reports=auditlib.audit_serving(
+            engine=engine, precision=args.serve_precision,
+            waive=args.audit_waive or ()))
+        _apply_audit(args, telemetry, result)
     startup = engine.startup()
     loads = args.serve_load or [20.0]
     stats = {}
@@ -235,6 +292,14 @@ def main(argv=None) -> None:
                                    port=args.port)
     telemetry = (Telemetry(args.telemetry_out)
                  if args.telemetry_out is not None else NULL)
+    if args.audit_zoo:
+        try:
+            audit_main(args, telemetry)
+        finally:
+            telemetry.update_manifest(
+                {"compilation_cache": compcache.cache_stats()})
+            telemetry.finalize()
+        return
     if args.serve_demo:
         try:
             serve_main(args, telemetry)
@@ -261,6 +326,19 @@ def main(argv=None) -> None:
         ft=ft_config_from_args(args),
     )
     try:
+        if args.audit != "off":
+            # Certify the programs THIS run dispatches (configured
+            # strategy's three train paths + eval) before any step runs;
+            # strict exits 2 with nothing trained.  After the Trainer's
+            # manifest write so the audit record merges instead of being
+            # clobbered.
+            from .analysis import audit as auditlib
+            _apply_audit(args, telemetry, auditlib.audit_zoo(
+                model=args.model, global_batch=args.batch_size,
+                precision=args.precision,
+                strategies=(args.strategy,),
+                num_devices=args.num_devices,
+                waive=args.audit_waive or ()))
         trainer.run(args.epochs, checkpoint_dir=args.checkpoint_dir,
                     profile_dir=args.profile_dir)
     finally:

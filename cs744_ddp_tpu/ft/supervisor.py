@@ -51,15 +51,29 @@ class Watchdog:
         self._on_timeout = on_timeout
         self._timer: Optional[threading.Timer] = None
         self._t0 = 0.0
+        # ``_fire`` runs on the Timer thread while ``__exit__``/readers
+        # run on the caller's; ``Timer.cancel`` does NOT wait for an
+        # in-flight callback, so without the lock + cancelled flag a
+        # watchdog could fire (and count a timeout) AFTER its body
+        # already completed — the lock makes cancel-vs-fire atomic
+        # (regression-tested in tests/test_analysis.py).
+        self._lock = threading.Lock()
+        self._cancelled = False
         self.fired = False
 
     def _fire(self):
-        self.fired = True
-        if self._on_timeout is not None:
-            self._on_timeout(time.perf_counter() - self._t0)
+        with self._lock:
+            if self._cancelled:
+                return
+            self.fired = True
+            if self._on_timeout is not None:
+                self._on_timeout(time.perf_counter() - self._t0)
 
     def __enter__(self) -> "Watchdog":
         self._t0 = time.perf_counter()
+        with self._lock:
+            self.fired = False
+            self._cancelled = False
         if self._timeout_s is not None and self._timeout_s > 0:
             self._timer = threading.Timer(self._timeout_s, self._fire)
             self._timer.daemon = True
@@ -69,6 +83,10 @@ class Watchdog:
     def __exit__(self, *exc):
         if self._timer is not None:
             self._timer.cancel()
+        with self._lock:
+            # After this point an in-flight ``_fire`` can no longer set
+            # ``fired`` or invoke the callback.
+            self._cancelled = True
         return False
 
 

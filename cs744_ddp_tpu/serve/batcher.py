@@ -151,24 +151,44 @@ class MicroBatcher:
 
     # -- lifecycle ----------------------------------------------------------
 
+    def _assert_owned(self) -> None:
+        """Assertion-mode lock-ownership check: every mutation of the
+        condition-guarded state (_pending/_pending_images/_stop/_worker)
+        must hold ``self._cond``.  ``_enqueue`` reads ``_stop``/``_worker``
+        under the lock, so an unlocked writer (the historical
+        ``start()``) races; compiled out under ``python -O`` like any
+        assert.  The same invariant is enforced statically by the
+        ``lock-ownership`` rule in analysis/pylint_rules.py."""
+        assert getattr(self._cond, "_is_owned", lambda: True)(), \
+            "MicroBatcher shared state mutated without holding self._cond"
+
     def start(self) -> "MicroBatcher":
-        if self._worker is not None:
-            raise RuntimeError("already started")
-        self._stop = False
-        self._worker = threading.Thread(target=self._run,
-                                        name="serve-microbatcher",
-                                        daemon=True)
-        self._worker.start()
+        with self._cond:
+            if self._worker is not None:
+                raise RuntimeError("already started")
+            self._assert_owned()
+            self._stop = False
+            # The worker's first action is to take self._cond, so starting
+            # it while we still hold the lock publishes _stop/_worker
+            # before it can observe either.
+            self._worker = threading.Thread(target=self._run,
+                                            name="serve-microbatcher",
+                                            daemon=True)
+            self._worker.start()
         return self
 
     def stop(self) -> None:
         """Drain what is queued, then stop the worker."""
         with self._cond:
+            self._assert_owned()
             self._stop = True
+            worker = self._worker
             self._cond.notify_all()
-        if self._worker is not None:
-            self._worker.join()
-            self._worker = None
+        if worker is not None:
+            worker.join()
+            with self._cond:
+                self._assert_owned()
+                self._worker = None
 
     def __enter__(self) -> "MicroBatcher":
         return self.start()
@@ -205,6 +225,7 @@ class MicroBatcher:
                 raise QueueFull(
                     f"queue holds {self._pending_images} images; adding "
                     f"{n} would exceed the {self.max_queue_images} bound")
+            self._assert_owned()
             self._pending.append(req)
             self._pending_images += n
             self._cond.notify_all()
@@ -225,6 +246,7 @@ class MicroBatcher:
                     deadline = self._pending[0].t_enqueue + self.max_wait_s
                     if (total == max_batch or k < len(self._pending)
                             or now >= deadline or self._stop):
+                        self._assert_owned()
                         batch = self._pending[:k]
                         del self._pending[:k]
                         self._pending_images -= total

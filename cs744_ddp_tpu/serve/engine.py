@@ -186,9 +186,19 @@ class InferenceEngine:
                                  warm=report["warm"])
         return report
 
-    def _compile(self, precision: str, bucket: int):
+    def lowered(self, bucket: int, precision: str = "f32"):
+        """Pre-compile lowering of one ladder rung — what the program
+        auditor (``analysis/audit.audit_serving``) inspects."""
         jit = self._jax.jit(self._forward[precision])
-        return jit.lower(*self._abstract_args(bucket)).compile()
+        return jit.lower(*self._abstract_args(bucket))
+
+    def lowered_hlo(self, bucket: int, precision: str = "f32") -> str:
+        """Pre-optimization HLO text of one ladder rung."""
+        return self.lowered(bucket, precision) \
+            .compiler_ir(dialect="hlo").as_hlo_text()
+
+    def _compile(self, precision: str, bucket: int):
+        return self.lowered(bucket, precision).compile()
 
     def _executable(self, bucket: int, precision: str):
         ex = self._exec.get((bucket, precision))

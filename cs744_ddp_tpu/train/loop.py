@@ -386,14 +386,14 @@ class Trainer:
 
     def _emit_collective_telemetry(self) -> None:
         """Counters/gauges for the compiled train step's collective pattern
-        (utils/hlo_stats over the pre-optimization HLO): op counts, result
+        (analysis/stats over the pre-optimization HLO): op counts, result
         bytes and chain depth — the static cost shape of the gradient-sync
         tier, attached to the run artifact.  Best-effort: backends that
         cannot produce the HLO print contribute an error gauge instead."""
         if self._collective_stats_emitted:
             return
         self._collective_stats_emitted = True
-        from ..utils import hlo_stats
+        from ..analysis import stats as hlo_stats
         try:
             x = jax.ShapeDtypeStruct(
                 (self.global_batch, 32, 32, 3),

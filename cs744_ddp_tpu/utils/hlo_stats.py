@@ -1,30 +1,46 @@
 """Collective-op statistics parsed from compiled HLO text.
 
-Feeds bench.py's ``spectrum`` section (VERDICT r3 items 3b/7): per-strategy
-collective instruction counts and result-buffer bytes from the TPU v5e-8
-AOT lowering — a static, wall-clock-noise-free record of each gradient-sync
-tier's cost shape.  The reference's tiers differ exactly here: Part 2a pays
-two sequential collectives per leaf with world x gather traffic
-(``/root/reference/src/Part 2a/main.py:117-127``), Part 2b one all-reduce
-per leaf (``Part 2b/main.py:116-119``), Part 3 a few fused bucket reduces
-(``Part 3/main.py:61``).
+.. deprecated::
+    This module is now a thin ADAPTER over the graph-IR implementation in
+    :mod:`cs744_ddp_tpu.analysis` (``analysis/hlo_ir.py`` parses the HLO
+    text structurally; ``analysis/stats.py`` does the accounting).  The
+    public API here (``bytes_of_type`` / ``collective_stats`` /
+    ``collective_chain_depth``) is unchanged and simply delegates; new
+    callers should import from ``cs744_ddp_tpu.analysis`` directly.  The
+    original regex implementation — print-format-sensitive, patched twice
+    (metadata-string poisoning, sum-vs-max chain depth) — survives below
+    as ``legacy_*`` functions ONLY as the oracle for the differential
+    test (tests/test_analysis.py) that pins old == new on every committed
+    fixture in tests/assets/hlo/.
 
-Byte accounting convention: for every collective instruction we sum the
-RESULT buffer sizes (tuple elements included).  For an all-reduce that is
-the reduced tensor's size; for an all-gather it is world x the input — the
-world-times-larger result is precisely the gather tier's traffic
-amplification, so the numbers surface the fidelity question VERDICT item 7
-asks about (symmetric all_gather vs the reference's root-link bottleneck;
-see BASELINE.md "Gather-tier traffic accounting").  Async pairs are counted
-once: the ``-start`` op contributes the instance count (its result tuple
-also holds source buffers, which would overcount bytes), the ``-done`` op
-contributes the result bytes.
+Byte accounting convention (both implementations): for every collective
+instruction we sum the RESULT buffer sizes (tuple elements included).
+For an all-reduce that is the reduced tensor's size; for an all-gather it
+is world x the input — the world-times-larger result is precisely the
+gather tier's traffic amplification (see BASELINE.md "Gather-tier
+traffic accounting").  Async pairs are counted once: the ``-start`` op
+contributes the instance count (its result tuple also holds source
+buffers, which would overcount bytes), the ``-done`` op contributes the
+result bytes.
 """
 
 from __future__ import annotations
 
 import re
 from typing import Dict
+
+from ..analysis.stats import (bytes_of_type, collective_chain_depth,
+                              collective_stats)
+
+__all__ = ["bytes_of_type", "collective_stats", "collective_chain_depth",
+           "legacy_bytes_of_type", "legacy_collective_stats",
+           "legacy_collective_chain_depth"]
+
+
+# ---------------------------------------------------------------------------
+# Legacy regex implementation — differential-test oracle only.  Do not add
+# callers; the maintained implementation lives in analysis/stats.py.
+# ---------------------------------------------------------------------------
 
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
@@ -33,10 +49,7 @@ _DTYPE_BYTES = {
 
 # `%name = <result-type> <collective-op>(...)`; -start before the bare op
 # name so the alternation matches the longest form.  The `%` sigil is
-# optional: some XLA versions / print options emit HLO text without it, and
-# requiring it would silently report zero collectives there (bench.py's
-# _collect_spectrum additionally refuses to record all-zero stats for
-# strategies that must contain collectives).
+# optional: some XLA versions / print options emit HLO text without it.
 _COLL_RE = re.compile(
     r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?P<type>.+?)\s+"
     r"(?P<op>all-reduce-start|all-reduce-done|all-reduce"
@@ -48,9 +61,8 @@ _COLL_RE = re.compile(
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 
 
-def bytes_of_type(type_str: str) -> int:
-    """Total bytes of every ``dtype[dims]`` shape in an HLO result type
-    (a bare shape or a tuple; layout/tiling annotations are ignored)."""
+def legacy_bytes_of_type(type_str: str) -> int:
+    """Regex oracle for :func:`analysis.stats.bytes_of_type`."""
     total = 0
     for dtype, dims in _SHAPE_RE.findall(type_str):
         if dtype not in _DTYPE_BYTES:
@@ -80,59 +92,30 @@ _REF_RE = re.compile(r"[%A-Za-z_][\w.\-]*")
 
 # Debug annotations on the instruction RHS that can contain identifier-like
 # tokens: `metadata={op_name="..." source_file="..."}` and bare string
-# literals.  Without stripping them, a metadata op_name that happens to
-# collide with an instruction (or computation) name fabricates a dependency
-# edge and inflates collective_chain_depth.  Strings are removed FIRST so a
-# brace inside a quoted path cannot truncate the metadata match; structural
-# refs (`to_apply=reducer`, `body=loop_body`) sit outside both and survive.
+# literals.  Strings are removed FIRST so a brace inside a quoted path
+# cannot truncate the metadata match.
 _STRING_RE = re.compile(r'"(?:[^"\\]|\\.)*"')
 _METADATA_RE = re.compile(r"metadata=\{[^{}]*\}")
 
 
 def _strip_annotations(rhs: str) -> str:
-    """RHS with string literals and ``metadata={...}`` blocks removed —
-    what reference extraction may safely tokenize."""
     return _METADATA_RE.sub("", _STRING_RE.sub("", rhs))
+
 
 _COLL_BASES = ("all-reduce", "all-gather", "reduce-scatter",
                "collective-permute", "all-to-all")
 
 
 def _collective_weight(op: str) -> int:
-    """1 for a collective instruction (async start/done pairs counted once,
-    on the start), else 0."""
     if op.endswith("-done"):
         return 0
     return int(re.sub(r"-start$", "", op) in _COLL_BASES)
 
 
-def collective_chain_depth(hlo_text: str) -> int:
-    """Longest dependency chain of collectives in the module: the number of
-    collectives that must execute SEQUENTIALLY (each consuming a value the
-    previous produced), regardless of how many run in total.
-
-    This is the latency SHAPE of a gradient-sync tier, statically: the
-    gather tier chains two dependent collectives per parameter leaf behind
-    a barrier chain (2 x 34 = 68 deep for VGG-11), the per-param all-reduce
-    tier one per leaf (34), the bucketed ddp tier one per ~25 MB bucket
-    (2) — the reference's Part 2a / 2b / 3 ordering
-    (``/root/reference/src/Part 3/main.py:61`` vs ``Part 2b/main.py:116``),
-    pinned even where wall-clock cannot be measured (tests/test_tpu_aot.py).
-
-    Feed it the PRE-OPTIMIZATION module print
-    (``lowered.compiler_ir(dialect="hlo").as_hlo_text()``): there the
-    strategies' ``optimization_barrier`` chains are still data
-    dependencies, so the depth is the sequencing the program semantically
-    imposes on the scheduler.  The post-scheduling print is NOT meaningful
-    input — barriers are dropped after scheduling and sequencing lives in
-    instruction order (and collectives hide inside async-wrapper
-    computations), so depth there undercounts.
-
-    Computed per computation over the SSA def-use graph (defs precede uses
-    in printed HLO); references to other computations (fusion bodies, while
-    bodies, reducers) add that computation's own internal depth.
-    """
-    # Split the module into computations; names are stored sigil-stripped.
+def legacy_collective_chain_depth(hlo_text: str) -> int:
+    """Regex oracle for :func:`analysis.stats.collective_chain_depth`
+    (same semantics: per-computation SSA def-use graph, async pairs
+    counted on the start, operand chains and callee internals SUM)."""
     comps: Dict[str, Dict[str, tuple]] = {}
     cur: Dict[str, tuple] = {}
     cur_name = None
@@ -169,14 +152,6 @@ def collective_chain_depth(hlo_text: str) -> int:
         best = 0
         for name, (op, refs) in instrs.items():
             w0 = _collective_weight(op)
-            # Operand chains and called-computation internals COMPOSE: the
-            # callee runs after the instruction's operands are ready, so an
-            # instruction whose deepest operand chain is A and whose called
-            # computation (while body, reducer, fusion) is internally B
-            # deep sits at A + B (+ its own weight) — taking max(A, B)
-            # undercounts every collective chain that FEEDS a
-            # collective-bearing called computation (pinned by
-            # tests/test_hlo_stats.py).
             operand_chain = 0
             callee_depth = 0
             for r in refs:
@@ -193,9 +168,8 @@ def collective_chain_depth(hlo_text: str) -> int:
     return max((depth_of_comp(c) for c in comps), default=0)
 
 
-def collective_stats(hlo_text: str) -> Dict:
-    """{"ops": {op: {"count", "result_mib"}}, "total_count",
-    "total_result_mib"} over every collective instruction in the module."""
+def legacy_collective_stats(hlo_text: str) -> Dict:
+    """Regex oracle for :func:`analysis.stats.collective_stats`."""
     ops: Dict[str, Dict[str, float]] = {}
     for line in hlo_text.splitlines():
         m = _COLL_RE.match(line)
@@ -207,7 +181,7 @@ def collective_stats(hlo_text: str) -> Dict:
         if not op.endswith("-done"):
             entry["count"] += 1
         if not op.endswith("-start"):
-            entry["result_mib"] += bytes_of_type(m.group("type")) / 2**20
+            entry["result_mib"] += legacy_bytes_of_type(m.group("type")) / 2**20
     for entry in ops.values():
         entry["result_mib"] = round(entry["result_mib"], 2)
     return {

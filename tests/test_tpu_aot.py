@@ -128,7 +128,7 @@ def test_collective_chain_depth_pins_latency_shape(v5e8_mesh):
     A regression that serializes the ddp buckets, de-fuses them (count
     tests above), or lets the combiner collapse a chained tier fails here
     even though the CPU backend cannot measure it."""
-    from cs744_ddp_tpu.utils.hlo_stats import collective_chain_depth
+    from cs744_ddp_tpu.analysis import collective_chain_depth
 
     depth = {
         name: collective_chain_depth(

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Project lint runner: AST rules from cs744_ddp_tpu/analysis/pylint_rules.
+
+Enforces the repo's concurrency/measurement invariants statically:
+un-fenced timing around device dispatches, jnp on producer/batcher
+threads, and shared-state writes outside the owning lock.  Exits nonzero
+on any finding, so it slots into CI as-is; tests/test_analysis.py runs
+the same check as a tier-1 test.
+
+    python tools/lint_graft.py              # lint the default targets
+    python tools/lint_graft.py serve ft     # lint specific paths
+
+Waive a line with ``# lint: ok`` or ``# lint: ok(rule-name)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from cs744_ddp_tpu.analysis.pylint_rules import (DEFAULT_TARGETS,  # noqa: E402
+                                                 lint_paths)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        "lint_graft", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: "
+                         + ", ".join(DEFAULT_TARGETS) + ")")
+    args = ap.parse_args(argv)
+    paths = args.paths or [os.path.join(_REPO_ROOT, t)
+                           for t in DEFAULT_TARGETS]
+    findings = lint_paths(paths)
+    for f in findings:
+        print(f"{os.path.relpath(f.path, _REPO_ROOT)}:{f.line}: "
+              f"[{f.rule}] {f.message}")
+    if findings:
+        print(f"{len(findings)} finding(s)")
+        return 1
+    print("lint_graft: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

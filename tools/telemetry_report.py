@@ -61,6 +61,38 @@ def _serving_lines(events) -> list:
     return lines
 
 
+def _audit_lines(manifest) -> list:
+    """Program-audit rendering (``--audit`` runs write
+    ``manifest["audit"]`` via analysis/audit.py's ``record_audit``):
+    verdict, per-program rule grid and any findings.  Returns [] when the
+    manifest carries no audit record — older runs render unchanged."""
+    audit = (manifest or {}).get("audit")
+    if not isinstance(audit, dict):
+        return []
+    lines = ["== program audit =="]
+    verdict = "CLEAN" if audit.get("clean") else "DIRTY"
+    lines.append(f"  {verdict}: {audit.get('n_programs', 0)} programs, "
+                 f"{audit.get('n_findings', 0)} findings, "
+                 f"{audit.get('n_waived', 0)} waived")
+    for prog, rec in sorted((audit.get("programs") or {}).items()):
+        rules = rec.get("rules") or {}
+        failed = sorted(r for r, v in rules.items() if v == "fail")
+        waived = sorted(r for r, v in rules.items() if v == "waived")
+        status = "FAIL " + ",".join(failed) if failed else "pass"
+        if waived:
+            status += f"  (waived {','.join(waived)})"
+        depth = rec.get("chain_depth")
+        lines.append(f"  {prog:<28} depth {depth!s:<4} {status}")
+    for f in audit.get("findings") or []:
+        lines.append(f"    !! {f.get('program')}: [{f.get('rule')}] "
+                     f"{f.get('message')}")
+    ladder = audit.get("ladder")
+    if ladder:
+        lines.append(f"  strategy depth ladder    {ladder}")
+    lines.append("")
+    return lines
+
+
 def render(out_dir: str) -> str:
     manifest, events, summary = read_run(out_dir)
     # A preempted/killed run legitimately truncates the final event line;
@@ -124,6 +156,7 @@ def render(out_dir: str) -> str:
         lines.append("")
 
     lines.extend(_serving_lines(events))
+    lines.extend(_audit_lines(manifest))
 
     gauges = {}
     for e in events:
