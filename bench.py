@@ -215,8 +215,9 @@ def measure_link_floor(log, *, global_batch: int, ndev: int,
 
     def _measure(buf: np.ndarray) -> dict:
         # Two alternating source buffers so no put can be served from a
-        # same-object cache; the second differs by a per-trial byte flip.
-        bufs = [buf, buf.copy()]
+        # same-object cache; they diverge by a per-trial byte flip.  Both
+        # are copies: buf may alias the memoized (read-only) split.
+        bufs = [buf.copy(), buf.copy()]
         best = float("inf")
         for t in range(trials + 1):   # +1 warmup (first put pays setup)
             src = bufs[t % 2]
@@ -734,6 +735,110 @@ def run_serving(log, *, model: str = "vgg11", buckets=None,
     return out
 
 
+def run_elastic(log, *, headline_model: str = "vgg11", ndev=None,
+                global_batch: int = 256, data_dir: str = "./data",
+                max_iters: int = 50, microshards: int = 4) -> dict:
+    """Elastic-layer numbers (``cs744_ddp_tpu/elastic/``), measured:
+
+    * ``shrink`` — an injected mid-epoch ``rank_death`` at full world: the
+      emergency-checkpoint + coordinator-shrink + rebuild-and-resume wall
+      clock, the world transition, and the steps-lost accounting (strong
+      scaling replays only the interrupted window — the step counter
+      itself carries over unchanged).
+    * ``grow`` — the shrunk run's checkpoint resumed back at the full
+      world: resume-plan numbers plus the rebuild+catch-up wall clock.
+    * ``degraded_throughput`` — steady-state throughput of the strong-
+      scaling microshard window at world 1 (the ladder's synchronous
+      fallback) vs the full mesh: what you KEEP while degraded.
+
+    Standalone-callable, like ``run_robustness``."""
+    import tempfile
+    import time as _time
+
+    import jax
+
+    from cs744_ddp_tpu.elastic import ElasticCoordinator
+    from cs744_ddp_tpu.ft import ChaosPlan, FTConfig
+    from cs744_ddp_tpu.utils.metrics import WINDOW
+
+    log = log or (lambda s: print(s, file=sys.stderr))
+    ndev = ndev or len(jax.devices())
+    # The pinned program exists at worlds dividing the microshard count.
+    world = max(w for w in range(1, min(ndev, microshards) + 1)
+                if microshards % w == 0 and global_batch % w == 0)
+    lim = max(max_iters, 2 * WINDOW)
+    out = {"protocol": "strong", "microshards": microshards,
+           "world": world, "global_batch": global_batch}
+
+    def mk(w, ft=None):
+        return _make_trainer(headline_model, "allreduce", w,
+                             global_batch=global_batch, data_dir=data_dir,
+                             log=lambda s: None, limit_train_batches=lim,
+                             limit_eval_batches=1, ft=ft, elastic="strong")
+
+    if world < 2:
+        log("[bench] elastic: single-device host — shrink/grow ladder "
+            "needs world >= 2; measuring degraded throughput only")
+    else:
+        # Shrink: rank (world-1) dies mid-epoch; the coordinator walks the
+        # ladder and the resumed run finishes the epoch at the new world.
+        death_step = lim // 2
+        log(f"[bench] elastic: shrink — rank_death at step {death_step} "
+            f"of {lim}, world {world}")
+        chaos = ChaosPlan([("rank_death", death_step, world - 1)])
+        with tempfile.TemporaryDirectory() as ckpt:
+            coord = ElasticCoordinator(
+                lambda w: mk(w, ft=FTConfig(chaos=chaos)),
+                world=world, global_batch=global_batch,
+                microshards=microshards, chaos=chaos, log=lambda s: None)
+            t0 = _time.time()
+            tr = coord.run(1, ckpt)
+            total_s = _time.time() - t0
+            ev = next(e for e in coord.events if e["kind"] == "shrink")
+            plan = tr.resume_plan
+            out["shrink"] = {
+                "from_world": ev["from_world"],
+                "to_world": ev["to_world"],
+                "death_step": ev["step"],
+                # Coordinator decision latency (probe + plan + membership
+                # transition) vs the full recovery including trainer
+                # rebuild, re-staging and the resumed epoch remainder.
+                "coordinator_recovery_s": round(ev["recovery_s"], 3),
+                "total_run_s": round(total_s, 3),
+                # Strong scaling: the step counter is world-invariant, so
+                # the only re-executed work is the interrupted window.
+                "steps_lost": (ev["step"] - plan.start_step
+                               if plan is not None else 0),
+            }
+
+            # Grow: resume the shrunk run's checkpoint back at full world.
+            log(f"[bench] elastic: grow — resuming at world {world}")
+            t0 = _time.time()
+            tr2 = mk(world)
+            tr2.run(2, checkpoint_dir=ckpt)
+            out["grow"] = {
+                "to_world": world,
+                "resume_run_s": round(_time.time() - t0, 3),
+            }
+
+    # Degraded-mode throughput: the pinned program at world 1 vs world N.
+    def _ips(w):
+        tr = mk(w)
+        return max(tr.steady_state_throughput(
+                       max_iters=max_iters, window_iters="epoch")[0]
+                   for _ in range(2))
+
+    log("[bench] elastic: degraded-mode throughput (world 1 fallback)")
+    degraded = _ips(1)
+    full = _ips(world) if world > 1 else degraded
+    out["degraded_throughput"] = {
+        "world1_images_per_sec": round(degraded, 2),
+        f"world{world}_images_per_sec": round(full, 2),
+        "degraded_fraction": round(degraded / full, 3) if full else None,
+    }
+    return out
+
+
 def run_audit(log, *, headline_model: str = "vgg11",
               global_batch: int = 256) -> Optional[dict]:
     """Static program audit (``cs744_ddp_tpu/analysis/audit.py``) over the
@@ -770,6 +875,7 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
               convergence_epochs: int = 3,
               spectrum: bool = True, host_pipeline: bool = True,
               robustness: bool = True, serving: bool = True,
+              elastic: bool = True,
               audit: bool = True,
               serving_kwargs=None,
               max_iters: int = 100,
@@ -1080,6 +1186,14 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         result["serving"] = run_serving(log, model=headline_model,
                                         **(serving_kwargs or {}))
 
+    # Elastic layer: shrink/grow resume latency, steps lost, and
+    # degraded single-rank throughput (cs744_ddp_tpu/elastic/).
+    if elastic:
+        result["elastic"] = run_elastic(
+            log, headline_model=headline_model, ndev=ndev,
+            global_batch=global_batch, data_dir=data_dir,
+            max_iters=max_iters)
+
     # Static program audit: the zoo's cost-shape certification rides in
     # the artifact next to the measurements it certifies.
     if audit:
@@ -1242,6 +1356,10 @@ def main(argv=None) -> None:
                    help="skip the serving fast-path section (bucket "
                         "throughput curve, open-loop latency, cold/warm "
                         "startup)")
+    p.add_argument("--no-elastic", action="store_true",
+                   help="skip the elastic section (shrink/grow resume "
+                        "latency, steps lost, degraded single-rank "
+                        "throughput)")
     p.add_argument("--no-audit", action="store_true",
                    help="skip the static program-zoo audit section "
                         "(analysis/audit.py cost-shape certification)")
@@ -1282,6 +1400,7 @@ def main(argv=None) -> None:
                        robustness=not (args.no_robustness
                                        or args.no_matrix),
                        serving=not (args.no_serving or args.no_matrix),
+                       elastic=not (args.no_elastic or args.no_matrix),
                        audit=not (args.no_audit or args.no_matrix),
                        max_iters=args.max_iters,
                        global_batch=args.global_batch)

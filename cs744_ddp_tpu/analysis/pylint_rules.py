@@ -22,7 +22,13 @@ silent mis-measurement or a rare race, not an exception):
   lock-owned; mutating it outside a ``with <lock>:`` block (``__init__``
   excepted) is a data race (this caught ``MicroBatcher.start`` writing
   ``_stop``/``_worker`` unlocked while ``_enqueue`` reads them under the
-  lock — fixed in the same PR that added the rule).
+  lock — fixed in the same PR that added the rule).  A class can also
+  DECLARE attributes lock-owned up front with a class-level
+  ``_lock_owned = ("attr", ...)`` tuple — those are guarded from the
+  first write on, whether or not a locked write is in view (the elastic
+  coordinator declares its membership state this way, so a new method
+  that mutates membership unlocked fails the lint even before any locked
+  counterpart exists).
 
 Waiver: append ``# lint: ok`` to the offending line to waive every rule,
 or ``# lint: ok(rule-name[, rule-name])`` to waive specific rules.  Run
@@ -228,6 +234,25 @@ def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
     return locks
 
 
+def _declared_lock_owned(cls: ast.ClassDef) -> Set[str]:
+    """Attributes the class PROMISES to mutate only under its lock, via a
+    class-level ``_lock_owned = ("attr", ...)`` tuple/list of string
+    literals.  Non-literal elements are ignored (the declaration must be
+    statically readable to mean anything here)."""
+    owned: Set[str] = set()
+    for stmt in cls.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "_lock_owned"
+                   for t in stmt.targets):
+            continue
+        if isinstance(stmt.value, (ast.Tuple, ast.List)):
+            owned |= {el.value for el in stmt.value.elts
+                      if isinstance(el, ast.Constant)
+                      and isinstance(el.value, str)}
+    return owned
+
+
 def _attr_writes_in_stmt(stmt: ast.stmt) -> List[Tuple[str, int]]:
     """self-attribute mutations in ONE statement (not descending into
     nested statements): assignments, augmented assignments, ``del``
@@ -316,6 +341,7 @@ def _check_lock_ownership(tree: ast.AST, path: str) -> List[LintFinding]:
             attr
             for method, writes in per_method.items()
             for attr, _, locked in writes if locked}
+        owned |= _declared_lock_owned(cls)
         owned -= locks   # the lock attribute itself is not guarded by itself
         for method, writes in per_method.items():
             if method == "__init__":

@@ -119,7 +119,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "step (repeatable); sites: producer_crash, "
                          "put_delay, put_fail, corrupt_slot, nonfinite_grad "
                          "(requires --nonfinite != off), preempt (requires "
-                         "--checkpoint-dir)")
+                         "--checkpoint-dir). Rank-level sites (the third "
+                         "field is the target RANK, not a seed — "
+                         "SITE:step:rank): rank_death, slow_rank; "
+                         "coordinator_loss fires on recovery progress "
+                         "(requires --elastic)")
     ft.add_argument("--ft-put-timeout", type=float, default=30.0,
                     metavar="SECONDS",
                     help="watchdog deadline on each staged chunk device_put")
@@ -135,6 +139,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="checksum every staged batch at fill time and "
                          "re-stage any row whose bytes changed by transfer "
                          "time (auto-enabled by corrupt_slot chaos)")
+    el = p.add_argument_group(
+        "elastic (elastic/)",
+        "checkpoint-based world-resize resume: a run interrupted at "
+        "world=N resumes at world=M with re-sharded data order; rank-level "
+        "chaos drives the retry -> shrink -> single-rank degradation "
+        "ladder (requires --checkpoint-dir)")
+    el.add_argument("--elastic", default="off",
+                    choices=["off", "weak", "strong"],
+                    help="weak = pinned per-chip batch (global batch scales "
+                         "with the world; deterministic, example-measured "
+                         "resume); strong = pinned global batch re-bucketed "
+                         "across the world with bitwise world-invariant "
+                         "math (microshard window, elastic/step_elastic.py)")
+    el.add_argument("--resume-world", type=int, default=None, metavar="M",
+                    help="run/resume at world size M (overrides "
+                         "--num-devices): checkpointed progress from any "
+                         "previous world is re-planned onto M under the "
+                         "--elastic protocol")
     sv = p.add_argument_group(
         "serving (serve/)",
         "single-chip inference: AOT bucket ladder + micro-batching + "
@@ -239,6 +261,48 @@ def audit_main(args, telemetry) -> None:
     _apply_audit(args, telemetry, result)
 
 
+def elastic_main(args, telemetry) -> None:
+    """--elastic: train under the ElasticCoordinator's degradation ladder.
+    The coordinator rebuilds the trainer at each membership generation;
+    ``--resume-world M`` starts (or resumes a checkpointed run) at world M.
+    Requires --checkpoint-dir — recovery and resize both go through the
+    emergency checkpoint protocol."""
+    import json
+
+    from .elastic import ElasticCoordinator
+    from .ft import NULL_CHAOS
+
+    if args.checkpoint_dir is None:
+        raise SystemExit("--elastic requires --checkpoint-dir (recovery "
+                         "and world-resize resume go through checkpoints)")
+    world = args.resume_world or args.num_devices or \
+        meshlib.make_mesh(None).devices.size
+    ft = ft_config_from_args(args)
+    # ONE chaos plan shared by trainer and coordinator: entries are
+    # one-shot across membership generations, so an injected fault fires
+    # in exactly one generation.
+    chaos = ft.chaos if ft is not None else NULL_CHAOS
+
+    def make_trainer(w: int) -> Trainer:
+        return Trainer(
+            model=args.model, strategy=args.strategy, num_devices=w,
+            global_batch=args.batch_size, data_dir=args.data_dir,
+            augment=not args.no_augment, precision=args.precision,
+            sgd_cfg=sgd.SGDConfig(lr=args.lr, momentum=args.momentum,
+                                  weight_decay=args.weight_decay),
+            limit_train_batches=args.limit_train_batches,
+            limit_eval_batches=args.limit_eval_batches,
+            telemetry=telemetry, ft=ft, elastic=args.elastic)
+
+    coord = ElasticCoordinator(
+        make_trainer, world=world, global_batch=args.batch_size,
+        protocol=args.elastic, chaos=chaos)
+    coord.run(args.epochs, checkpoint_dir=args.checkpoint_dir)
+    report = coord.report()
+    telemetry.update_manifest({"elastic_report": report})
+    print("elastic report: " + json.dumps(report))
+
+
 def serve_main(args, telemetry) -> None:
     """--serve-demo: build the ladder, replay the seeded trace at each
     offered load, print ONE JSON line (startup report + per-load stats)."""
@@ -307,6 +371,18 @@ def main(argv=None) -> None:
             telemetry.update_manifest(
                 {"compilation_cache": compcache.cache_stats()})
             telemetry.finalize()
+        return
+    if args.resume_world is not None and args.elastic == "off":
+        raise SystemExit("--resume-world requires --elastic (weak|strong): "
+                         "without a declared protocol there is no defined "
+                         "mapping of saved progress onto a new world size")
+    if args.elastic != "off":
+        try:
+            elastic_main(args, telemetry)
+        finally:
+            telemetry.update_manifest(
+                {"compilation_cache": compcache.cache_stats()})
+            telemetry.finalize(global_batch=args.batch_size)
         return
     trainer = Trainer(
         model=args.model,

@@ -19,6 +19,7 @@ Channel normalization stats match the reference exactly
 
 from __future__ import annotations
 
+import functools
 import os
 import pickle
 from typing import NamedTuple, Tuple
@@ -88,6 +89,7 @@ def _class_templates() -> np.ndarray:
     return np.repeat(np.repeat(small, 8, axis=2), 8, axis=3)
 
 
+@functools.lru_cache(maxsize=8)
 def _synthetic_split(n: int, seed: int) -> Split:
     """Class-templated noisy images: deterministic, learnable, NOT trivial.
 
@@ -97,7 +99,13 @@ def _synthetic_split(n: int, seed: int) -> Split:
     Calibrated (see knob comments above) so reference-config training
     rises epoch over epoch while staying between the 10% chance floor and
     saturation — the shape a convergence ORACLE needs to detect both a
-    broken step (stuck at chance) and a degenerate task (instant 100%)."""
+    broken step (stuck at chance) and a degenerate task (instant 100%).
+
+    Memoized: generating the full 50k split costs ~4 s of pure numpy, and
+    multi-trainer processes (bench sections, the elastic coordinator's
+    shrink/resume ladder) would otherwise pay it per Trainer.  The cached
+    arrays are shared across callers and therefore read-only; consumers
+    that need to mutate must copy."""
     rng = np.random.default_rng(seed)
     templates = _class_templates()
     labels = rng.integers(0, NUM_CLASSES, size=n).astype(np.int32)
@@ -109,7 +117,10 @@ def _synthetic_split(n: int, seed: int) -> Split:
         flip = rng.random(n) < _LABEL_NOISE
         labels = np.where(flip, rng.integers(0, NUM_CLASSES, size=n),
                           labels).astype(np.int32)
-    return Split(np.clip(images, 0, 255).astype(np.uint8), labels)
+    images = np.clip(images, 0, 255).astype(np.uint8)
+    images.setflags(write=False)
+    labels.setflags(write=False)
+    return Split(images, labels)
 
 
 def has_real_data(data_dir: str = "./data") -> bool:

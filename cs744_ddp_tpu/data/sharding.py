@@ -19,6 +19,46 @@ from __future__ import annotations
 import numpy as np
 
 
+def _wrap_pad(perm: np.ndarray, total: int) -> np.ndarray:
+    """Pad ``perm`` to ``total`` by wrapping, exactly as torch's
+    DistributedSampler does — including the degenerate case where the
+    padding EXCEEDS the dataset (total > 2n, e.g. a tiny split resharded
+    onto a large world): torch tiles the whole index list
+    (``(indices * ceil(pad/len))[:pad]``), and so must we.  The previous
+    single-concatenate wrap silently produced a SHORT list there, which
+    would desynchronize rank streams after a world resize — the elastic
+    resume planner depends on this order being a pure function of
+    (seed, epoch), never of world size."""
+    if total <= perm.shape[0]:
+        return perm[:total]
+    reps = -(-total // perm.shape[0])  # ceil
+    return np.concatenate([perm] * reps)[:total]
+
+
+def canonical_epoch_order(n: int, *, seed: int = 0, shuffle: bool = True,
+                          epoch: int = 0, reshuffle_each_epoch: bool = False,
+                          pad_to: int | None = None) -> np.ndarray:
+    """The world-INVARIANT canonical example order for ``epoch``.
+
+    This is the permutation every ``ShardedSampler`` deals from: rank r of
+    world w takes positions ``r::w`` of this order (after wrap-padding), so
+    the column-major flatten of ``global_epoch_indices(n, w)`` equals a
+    prefix of this array FOR EVERY w (pinned by tests/test_elastic.py).
+    That invariance is the seam elastic resume rides: global batch b covers
+    canonical positions [b*B, (b+1)*B) regardless of world size, so a
+    checkpoint taken at world=N can be resumed at world=M without
+    re-deriving which examples were consumed.
+    """
+    if shuffle:
+        s = seed + (epoch if reshuffle_each_epoch else 0)
+        perm = np.random.default_rng(s).permutation(n)
+    else:
+        perm = np.arange(n)
+    if pad_to is not None:
+        perm = _wrap_pad(perm, pad_to)
+    return perm
+
+
 class ShardedSampler:
     """Per-rank epoch index streams over a dataset of ``n`` examples."""
 
@@ -37,15 +77,14 @@ class ShardedSampler:
 
     def epoch_indices(self, epoch: int = 0) -> np.ndarray:
         """Indices this rank processes in ``epoch`` (len == num_samples)."""
-        if self.shuffle:
-            # Reference never reshuffles (no set_epoch); epoch enters the
-            # seed only when explicitly requested.
-            s = self.seed + (epoch if self.reshuffle_each_epoch else 0)
-            perm = np.random.default_rng(s).permutation(self.n)
-        else:
-            perm = np.arange(self.n)
-        if self.total > self.n:  # pad by wrapping, as torch does
-            perm = np.concatenate([perm, perm[: self.total - self.n]])
+        # Reference never reshuffles (no set_epoch); epoch enters the
+        # seed only when explicitly requested.  The wrap-pad (torch
+        # semantics, tiled for world > 2n) happens on the CANONICAL order,
+        # so rank streams for every world size deal from one permutation.
+        perm = canonical_epoch_order(
+            self.n, seed=self.seed, shuffle=self.shuffle, epoch=epoch,
+            reshuffle_each_epoch=self.reshuffle_each_epoch,
+            pad_to=self.total)
         return perm[self.rank:: self.world]
 
 

@@ -1,0 +1,179 @@
+"""Elastic coordinator: cluster membership and the degradation ladder.
+
+The coordinator owns what the SPMD trainer cannot: the decision of WHAT
+WORLD SIZE to run at.  It drives trainers built by a ``make_trainer(world)``
+factory; when a run comes back with ``trainer.rank_death`` set (the trainer
+already wrote its emergency mid-epoch checkpoint before returning), the
+coordinator walks the ladder:
+
+  1. **retry**  — if the reported rank probes healthy
+     (``parallel.mesh.probe_devices``) and ``trust_probe`` is set, the
+     fault is treated as transient and the SAME world is retried (bounded
+     by ``max_retries``).  Off by default: on the CPU virtual mesh every
+     probe passes, so a chaos-injected death must be taken at face value
+     or the shrink path would never run.
+  2. **shrink** — rebuild at the LARGEST feasible world <= M-1
+     (``protocol.plan_shrink``: global-batch divisibility, and under
+     strong scaling microshard divisibility).  The resumed run restores
+     the emergency checkpoint; under strong scaling its remaining
+     trajectory is bitwise-equal to a fault-free run at the target world
+     (pinned by tests/test_ft.py).
+  3. **single-rank fallback** — repeated deaths keep shrinking until
+     world=1, the synchronous degenerate case (``degraded`` is set).
+
+Membership transitions happen UNDER THE SUPERVISOR LOCK — the chaos
+``coordinator_loss`` site drops the in-memory membership mid-recovery and
+the coordinator must re-derive it from checkpoint metadata alone
+(``train.checkpoint.read_*_meta``), which is also why recovery stays
+bitwise: nothing the coordinator decides from depends on state that only
+lived in memory.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..ft import NULL_CHAOS
+from ..parallel import mesh as meshlib
+from ..train import checkpoint as ckptlib
+from .protocol import flat_meta, plan_shrink, world_of
+
+
+class ElasticCoordinator:
+    """Membership + ladder driver over a ``make_trainer(world)`` factory."""
+
+    # Membership transitions must happen under the supervisor lock; the
+    # lint_graft lock-ownership rule enforces this statically via the
+    # declaration (analysis/pylint_rules.py: class-level ``_lock_owned``).
+    _lock_owned = ("world", "members", "generation", "degraded")
+
+    def __init__(self, make_trainer: Callable, *, world: int,
+                 global_batch: int, protocol: str = "strong",
+                 microshards: Optional[int] = 4, chaos=NULL_CHAOS,
+                 max_retries: int = 1, trust_probe: bool = False,
+                 log: Callable[[str], None] = print):
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        self._make_trainer = make_trainer
+        self._lock = threading.Lock()
+        self.log = log
+        self.chaos = chaos
+        self.global_batch = global_batch
+        self.protocol = protocol
+        self.microshards = microshards if protocol == "strong" else None
+        self.max_retries = max_retries
+        self.trust_probe = trust_probe
+        self.retries_used = 0
+        self.recoveries = 0
+        self.events: List[dict] = []
+        self.trainer = None
+        # __init__ establishes the membership state (lint: construction
+        # writes are exempt); every later transition is lock-guarded.
+        self.world = world
+        self.members = tuple(range(world))
+        self.generation = 0
+        self.degraded = world == 1
+
+    # -- the run loop -------------------------------------------------------
+
+    def run(self, epochs: int, checkpoint_dir: str):
+        """Train to completion under the ladder; returns the final trainer
+        (whose state/telemetry belong to the world that finished)."""
+        while True:
+            trainer = self._make_trainer(self.world)
+            t0 = time.time()
+            trainer.run(epochs, checkpoint_dir=checkpoint_dir)
+            death = getattr(trainer, "rank_death", None)
+            if death is None:
+                self.trainer = trainer
+                return trainer
+            self._recover(trainer, death, checkpoint_dir,
+                          run_time_s=time.time() - t0)
+
+    # -- recovery -----------------------------------------------------------
+
+    def _recover(self, trainer, death, checkpoint_dir: str, *,
+                 run_time_s: float) -> None:
+        rank, epoch, step = death
+        self.recoveries += 1
+        t0 = time.time()
+        if self.chaos.enabled and self.chaos.fire_reached(
+                "coordinator_loss", self.recoveries - 1):
+            with self._lock:
+                self.members = ()
+            self.log("chaos: coordinator membership state lost; "
+                     "re-deriving from checkpoint metadata")
+            self._rederive_membership(checkpoint_dir)
+        dead = set(meshlib.probe_devices(trainer.mesh))
+        if self.trust_probe and rank not in dead and \
+                self.retries_used < self.max_retries:
+            # Rung 1: the rank probes healthy — transient fault, retry at
+            # the same world.  The emergency checkpoint makes the retry a
+            # plain resume; nothing about membership changes.
+            self.retries_used += 1
+            self.events.append({
+                "kind": "retry", "rank": rank, "epoch": epoch,
+                "step": step, "world": self.world,
+                "recovery_s": time.time() - t0})
+            self.log(f"elastic: rank {rank} probes healthy; retrying at "
+                     f"world {self.world} "
+                     f"({self.retries_used}/{self.max_retries})")
+            return
+        # Rung 2/3: the rank is gone — shrink to the largest feasible
+        # world; repeated deaths walk this down to the world=1 synchronous
+        # fallback.
+        dead.add(rank)
+        if self.world <= 1:
+            raise RuntimeError(
+                f"rank {rank} died at world 1 — no smaller world to "
+                f"degrade to (epoch {epoch} step {step})")
+        new_world = plan_shrink(self.world, self.global_batch,
+                                microshards=self.microshards)
+        with self._lock:
+            old_world = self.world
+            members = self.members or tuple(range(old_world))
+            survivors = tuple(m for m in members if m not in dead)
+            self.members = survivors[:new_world]
+            self.world = new_world
+            self.generation += 1
+            self.degraded = new_world == 1
+        self.events.append({
+            "kind": "shrink", "rank": rank, "epoch": epoch, "step": step,
+            "from_world": old_world, "to_world": new_world,
+            "run_time_s": run_time_s, "recovery_s": time.time() - t0})
+        self.log(f"elastic: rank {rank} died at epoch {epoch} step {step}; "
+                 f"shrinking world {old_world} -> {new_world}"
+                 + (" (single-rank fallback)" if new_world == 1 else ""))
+
+    def _rederive_membership(self, checkpoint_dir: str) -> None:
+        """Rebuild membership from checkpoint metadata alone (the
+        ``coordinator_loss`` recovery path): the trainer's emergency save
+        always lands before the coordinator recovers, so disk is the
+        authoritative record of the world that was running."""
+        meta = flat_meta(ckptlib.read_mid_epoch_meta(checkpoint_dir)
+                         or ckptlib.read_epoch_meta(checkpoint_dir))
+        if not meta:
+            raise RuntimeError(
+                "coordinator state lost and no checkpoint metadata on "
+                "disk to re-derive membership from")
+        w = world_of(meta)
+        with self._lock:
+            self.world = w
+            self.members = tuple(range(w))
+
+    # -- reporting ----------------------------------------------------------
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "world": self.world,
+                "members": list(self.members),
+                "generation": self.generation,
+                "degraded": self.degraded,
+                "protocol": self.protocol,
+                "recoveries": self.recoveries,
+                "retries_used": self.retries_used,
+                "events": list(self.events),
+            }

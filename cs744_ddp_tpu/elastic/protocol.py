@@ -1,0 +1,215 @@
+"""Elastic resume planning: map a checkpoint taken at world=N onto world=M.
+
+The whole layer rides one invariant of ``data/sharding.py``: the canonical
+epoch order is a pure function of (seed, epoch) — rank r of world w deals
+positions ``r::w`` of the SAME permutation for every w (torch
+DistributedSampler semantics, wrap-pad tiled).  So "which examples has the
+run consumed" is world-independent, and a resume plan only has to translate
+the step counter between batch geometries.
+
+Two declared protocols:
+
+* ``strong`` — the global batch is pinned (reference: 256) and re-bucketed
+  across the new world.  Under the elastic step program
+  (``step_elastic.py``) the math is bitwise world-invariant, so the step
+  counter carries over unchanged: ``start_step = step``, zero replay, and
+  the loss trajectory at world 1→2→4 is identical (CI-pinned).
+* ``weak``   — the PER-CHIP batch is pinned, so the global batch scales
+  with the world.  Progress is measured in examples; the new step counter
+  is ``examples_done // new_global_batch`` (floor), which re-processes up
+  to one new-batch of examples rather than skipping any.  Deterministic,
+  but not replay-exact — the replayed-example count is reported in the
+  plan, not hidden.
+
+``world_of`` is the forward/backward-compat seam: checkpoints from before
+round 6 carry no world metadata and restore as ``world=1`` with a one-time
+warning instead of a KeyError.
+"""
+
+from __future__ import annotations
+
+import warnings
+import zlib
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..data.sharding import canonical_epoch_order
+
+PROTOCOLS = ("weak", "strong")
+
+# How many leading indices of each rank stream the data-order key digests.
+_KEY_PREFIX = 64
+
+_warned_missing_world = False
+
+
+class ElasticConfig(NamedTuple):
+    """Elastic-mode knobs carried by the Trainer.
+
+    protocol    : "strong" (pinned global batch, bitwise world-invariant
+                  math) or "weak" (pinned per-chip batch).
+    microshards : S — the fixed decomposition of every strong-protocol
+                  global batch.  Must be a power of two and divide the
+                  global batch; every world size M with M | S can run the
+                  SAME per-microshard math (rank r scans S/M microshards),
+                  which is what makes the trajectory world-invariant.
+    """
+
+    protocol: str = "strong"
+    microshards: int = 4
+
+
+class ResumePlan(NamedTuple):
+    """The output of ``plan_resume`` — everything the trainer needs to
+    continue a run at a different world size."""
+
+    protocol: str
+    old_world: int
+    new_world: int
+    old_global_batch: int
+    new_global_batch: int
+    start_epoch: int
+    start_step: int
+    examples_replayed: int  # weak protocol floor-rounding; 0 under strong
+    steps_lost: int         # completed old steps whose work is re-executed
+
+
+def flat_meta(meta: Optional[dict]) -> dict:
+    """One flat view over both checkpoint metadata shapes: mid-epoch
+    sidecars nest the topology/data-order keys under ``data_order``
+    (historical shape, kept for compat), epoch sidecars keep them
+    top-level.  Returns {} for None."""
+    if not meta:
+        return {}
+    flat = {k: v for k, v in meta.items() if k != "data_order"}
+    flat.update(meta.get("data_order") or {})
+    return flat
+
+
+def world_of(meta: Optional[dict]) -> int:
+    """The world size recorded in checkpoint metadata — with the
+    backward-compat default: pre-round-6 checkpoints carry no ``world``
+    key and restore as world=1 (the reference's Part 1 case), warning
+    once per process instead of raising KeyError."""
+    global _warned_missing_world
+    if meta and "world" in meta:
+        return int(meta["world"])
+    if not _warned_missing_world:
+        _warned_missing_world = True
+        warnings.warn(
+            "checkpoint metadata carries no world size (pre-elastic "
+            "format); assuming world=1 — re-save under round 6+ to "
+            "record topology", stacklevel=2)
+    return 1
+
+
+def rank_data_keys(n: int, world: int, *, seed: int = 0, epoch: int = 0,
+                   shuffle: bool = True,
+                   reshuffle_each_epoch: bool = False) -> Tuple[int, ...]:
+    """Per-rank data-order keys: a crc32 digest of the first
+    ``_KEY_PREFIX`` indices each rank deals in ``epoch``.  Written into
+    checkpoint metadata at save time and re-derived at resume time —
+    a mismatch means the dataset/seed changed under the checkpoint, which
+    would silently desynchronize the resumed stream."""
+    num = -(-n // world) * world
+    order = canonical_epoch_order(
+        n, seed=seed, shuffle=shuffle, epoch=epoch,
+        reshuffle_each_epoch=reshuffle_each_epoch, pad_to=num)
+    return tuple(
+        int(zlib.crc32(np.ascontiguousarray(
+            order[r::world][:_KEY_PREFIX], dtype=np.int64).tobytes()))
+        for r in range(world))
+
+
+def validate_rank_keys(meta: dict, n: int) -> None:
+    """Check the saved per-rank data-order keys against a fresh
+    derivation; no-op when the metadata predates them (compat).  Accepts
+    either metadata shape (flattens internally)."""
+    flat = flat_meta(meta)
+    saved = flat.get("rank_keys")
+    if not saved:
+        return
+    fresh = rank_data_keys(
+        n, world_of(flat), seed=int(flat.get("seed", 0)),
+        epoch=int(flat.get("epoch", 0)),
+        shuffle=bool(flat.get("shuffle", True)),
+        reshuffle_each_epoch=bool(flat.get("reshuffle_each_epoch", False)))
+    if tuple(saved) != fresh:
+        raise ValueError(
+            "checkpoint data-order keys do not match this dataset/seed — "
+            f"saved {tuple(saved)}, derived {fresh}; resuming would "
+            "desynchronize the example stream")
+
+
+def plan_resume(meta: Optional[dict], new_world: int, *,
+                protocol: Optional[str] = None,
+                microshards: Optional[int] = None,
+                default_global_batch: Optional[int] = None) -> ResumePlan:
+    """Translate checkpoint progress at ``world_of(meta)`` into a start
+    position at ``new_world`` under the declared protocol."""
+    meta = meta or {}
+    old_world = world_of(meta)
+    proto = protocol or meta.get("protocol") or "strong"
+    if proto not in PROTOCOLS:
+        raise ValueError(f"unknown elastic protocol {proto!r}; "
+                         f"expected one of {PROTOCOLS}")
+    if new_world < 1:
+        raise ValueError(f"new world must be >= 1, got {new_world}")
+    old_gb = meta.get("global_batch", default_global_batch)
+    if old_gb is None:
+        raise ValueError("checkpoint metadata carries no global_batch and "
+                         "no default was provided")
+    old_gb = int(old_gb)
+    epoch = int(meta.get("epoch", 0))
+    step = int(meta.get("step", 0))
+
+    if proto == "strong":
+        if old_gb % new_world:
+            raise ValueError(
+                f"strong scaling: global batch {old_gb} not divisible by "
+                f"new world {new_world}")
+        if microshards is not None:
+            if microshards % new_world:
+                raise ValueError(
+                    f"strong scaling: microshards {microshards} not "
+                    f"divisible by new world {new_world}")
+            if old_gb % microshards:
+                raise ValueError(
+                    f"strong scaling: global batch {old_gb} not divisible "
+                    f"by microshards {microshards}")
+        # Global batch b covers canonical positions [b*B, (b+1)*B) at
+        # EVERY world size, so the step counter is world-invariant.
+        return ResumePlan(proto, old_world, new_world, old_gb, old_gb,
+                          epoch, step, 0, 0)
+
+    # weak scaling: pinned per-chip batch, example-measured progress.
+    if old_gb % old_world:
+        raise ValueError(f"weak scaling: saved global batch {old_gb} not "
+                         f"divisible by saved world {old_world}")
+    per_chip = old_gb // old_world
+    new_gb = per_chip * new_world
+    examples_done = step * old_gb
+    start_step = examples_done // new_gb
+    replayed = examples_done - start_step * new_gb
+    steps_lost = step - (start_step * new_gb) // old_gb
+    return ResumePlan(proto, old_world, new_world, old_gb, new_gb,
+                      epoch, start_step, replayed, steps_lost)
+
+
+def plan_shrink(world: int, global_batch: int, *,
+                microshards: Optional[int] = None) -> int:
+    """The shrink rung of the degradation ladder: the LARGEST world
+    w <= world-1 the batch geometry admits (global batch divisible, and
+    under strong scaling w | microshards so the elastic program exists).
+    Always reaches 1 — the synchronous single-rank fallback divides
+    everything."""
+    if world < 2:
+        raise ValueError(f"cannot shrink below world 1 (world={world})")
+    for w in range(world - 1, 0, -1):
+        if global_batch % w:
+            continue
+        if microshards is not None and microshards % w:
+            continue
+        return w
+    return 1

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 from typing import Any, NamedTuple
 
-from .chaos import NULL_CHAOS, ChaosError, ChaosPlan, NullChaos, SITES
+from .chaos import (NULL_CHAOS, RANK_SITES, ChaosError, ChaosPlan, NullChaos,
+                    RankDeathError, SITES)
 from .guard import POLICIES, NonFiniteError
 from .preempt import PreemptedError, PreemptionGuard
 from .supervisor import (StagingStalled, Watchdog, batch_checksums,
@@ -37,6 +38,9 @@ class FTConfig(NamedTuple):
                         (auto-enabled when the chaos plan corrupts slots).
     degrade_staging   : start in the degraded synchronous staging mode
                         (bench/testing knob — measures the fallback).
+    slow_rank_stall_s : stall injected per ``slow_rank`` chaos entry and
+                        attributed to the target rank's step-time gauge
+                        (elastic/straggler.py must flag it).
     """
 
     nonfinite: str = "off"
@@ -48,10 +52,12 @@ class FTConfig(NamedTuple):
     producer_restarts: int = 1
     verify_chunks: bool = False
     degrade_staging: bool = False
+    slow_rank_stall_s: float = 0.25
 
 
 __all__ = [
     "FTConfig", "ChaosPlan", "ChaosError", "NullChaos", "NULL_CHAOS", "SITES",
+    "RANK_SITES", "RankDeathError",
     "POLICIES", "NonFiniteError", "PreemptedError", "PreemptionGuard",
     "StagingStalled", "Watchdog", "call_with_retry", "batch_checksums",
     "verify_checksums",

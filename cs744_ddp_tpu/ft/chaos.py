@@ -23,6 +23,25 @@ Injection sites (each names a real failure mode of the training stack):
 * ``preempt``          — SIGTERM is delivered to this process at the first
                          step boundary >= ``step`` (pod preemption).
 
+Rank-level sites (elastic/ — round 6's world-resize layer).  For these the
+third spec field is the RANK the fault is attributed to, not a payload
+seed (``rank_death:step:rank``):
+
+* ``rank_death``       — rank ``rank``'s device fails at the first step
+                         boundary >= ``step``; the trainer raises
+                         ``RankDeathError`` and the elastic coordinator
+                         walks its degradation ladder (retry -> shrink ->
+                         single-rank fallback);
+* ``slow_rank``        — rank ``rank`` straggles: a configurable stall
+                         (``FTConfig.slow_rank_stall_s``) is injected at
+                         the step boundary and attributed to that rank's
+                         step-time gauge, which the straggler detector
+                         must flag;
+* ``coordinator_loss`` — the elastic coordinator's in-memory membership
+                         state is dropped once recovery progress reaches
+                         ``step``; it must re-derive membership from the
+                         checkpoint metadata alone.
+
 The disabled plan is ``NULL_CHAOS`` — a stateless singleton exactly like
 the telemetry ``NULL`` recorder: ``enabled`` is False, ``fire*`` return
 False without allocating, and hot call sites guard on ``.enabled`` so the
@@ -35,12 +54,32 @@ import threading
 from typing import List, Optional, Sequence, Tuple
 
 SITES = ("producer_crash", "put_delay", "put_fail", "corrupt_slot",
-         "nonfinite_grad", "preempt")
+         "nonfinite_grad", "preempt", "rank_death", "slow_rank",
+         "coordinator_loss")
+# Sites whose third spec field names the target RANK (elastic/), not a
+# payload seed — same wire format, different interpretation.
+RANK_SITES = ("rank_death", "slow_rank")
 
 
 class ChaosError(RuntimeError):
     """An injected fault (never raised by real failures — recovery paths
     that catch broadly still distinguish injected faults in telemetry)."""
+
+
+class RankDeathError(RuntimeError):
+    """Rank ``rank``'s device failed at a step boundary.  Raised by the
+    trainer's boundary poll (injected by the ``rank_death`` chaos site, or
+    by a real device-probe failure); the trainer converts it into an
+    emergency mid-epoch checkpoint and the elastic coordinator
+    (elastic/coordinator.py) walks its degradation ladder.  Lives here —
+    not in elastic/ — because the trainer must catch it without importing
+    the elastic layer (which imports the trainer's step machinery)."""
+
+    def __init__(self, rank: int, epoch: int, step: int):
+        super().__init__(f"rank {rank} died at epoch {epoch} step {step}")
+        self.rank = rank
+        self.epoch = epoch
+        self.step = step
 
 
 class NullChaos:
@@ -59,6 +98,9 @@ class NullChaos:
 
     def steps(self, site: str) -> Tuple[int, ...]:
         return ()
+
+    def seed_of(self, site: str, step: int) -> int:
+        return 0
 
     def spec(self):
         return []
@@ -138,6 +180,15 @@ class ChaosPlan:
         """All step indices planned for ``site`` (fired or not) — what the
         compiled-in injection closures are built from."""
         return tuple(e["step"] for e in self._entries if e["site"] == site)
+
+    def seed_of(self, site: str, step: int) -> int:
+        """The third spec field of the entry planned at (site, step) — a
+        payload seed for data-level sites, the target RANK for the
+        rank-level sites (RANK_SITES).  0 when no such entry exists."""
+        for e in self._entries:
+            if e["site"] == site and e["step"] == step:
+                return e["seed"]
+        return 0
 
     def spec(self):
         """Manifest-shaped view of the plan (site/step/seed per entry)."""

@@ -95,6 +95,10 @@ def percentile(values: List[float], q: float) -> float:
     rank = (q / 100.0) * (len(xs) - 1)
     lo = int(rank)
     hi = min(lo + 1, len(xs) - 1)
+    if xs[lo] == xs[hi]:
+        # Exact, not interpolated: a*(1-f) + a*f can drift a ulp, which
+        # breaks p50 <= p95 <= p99 monotonicity on repeated samples.
+        return float(xs[lo])
     frac = rank - lo
     return float(xs[lo] * (1.0 - frac) + xs[hi] * frac)
 
@@ -344,6 +348,19 @@ def summarize_events(events: List[Dict[str, Any]],
     for e in events:
         if e.get("kind") == "gauge":
             gauges[e["name"]] = e["value"]   # last write wins
+    # Per-rank step-time aggregation (elastic runs emit one
+    # ``rank_step_time_s`` gauge per rank per window boundary).
+    ranks: Dict[str, Dict[str, float]] = {}
+    for e in events:
+        if e.get("kind") == "gauge" and e.get("name") == "rank_step_time_s" \
+                and "rank" in e:
+            agg = ranks.setdefault(str(e["rank"]), {
+                "count": 0, "total_s": 0.0, "max_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += e["value"]
+            agg["max_s"] = max(agg["max_s"], e["value"])
+    for agg in ranks.values():
+        agg["mean_s"] = agg["total_s"] / agg["count"]
 
     summary: Dict[str, Any] = {
         "schema_version": _SCHEMA_VERSION,
@@ -354,6 +371,8 @@ def summarize_events(events: List[Dict[str, Any]],
         "counters": counters,
         "gauges": gauges,
     }
+    if ranks:
+        summary["ranks"] = ranks
     if steps:
         summary["final_loss"] = steps[-1]["loss"]
         summary["mean_loss"] = sum(s["loss"] for s in steps) / len(steps)

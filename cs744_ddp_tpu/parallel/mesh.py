@@ -74,6 +74,50 @@ def make_mesh(num_devices: Optional[int] = None,
     return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 
+def probe_devices(mesh: Mesh) -> list:
+    """Health-probe every device in ``mesh``: run a tiny computation on each
+    and return the list of rank indices that FAILED it.
+
+    This is the elastic coordinator's liveness check — after a
+    ``RankDeathError`` (or any suspicion of a sick chip) it probes before
+    deciding which rung of the degradation ladder applies: an empty list
+    means the fault was transient (retry at the same world), a non-empty
+    list names the ranks to exclude when shrinking.  On the CPU virtual
+    mesh every device always passes; real failures are simulated by the
+    ``rank_death`` chaos site, whose target rank the coordinator merges
+    into this probe's result.
+    """
+    dead = []
+    for rank, dev in enumerate(mesh.devices.flat):
+        try:
+            out = jax.device_put(np.int32(rank), dev)
+            if int(out) != rank:
+                dead.append(rank)
+        except Exception:  # noqa: BLE001 - any failure marks the rank dead
+            dead.append(rank)
+    return dead
+
+
+def shrink_mesh(mesh: Mesh, new_world: int, exclude: Sequence[int] = ()) \
+        -> Mesh:
+    """A 1-D mesh over the first ``new_world`` SURVIVING devices of ``mesh``.
+
+    ``exclude`` lists dead rank indices (from ``probe_devices`` or the
+    chaos plan); survivors keep their relative order so rank identities
+    stay stable across the shrink — the resume planner's re-shard map
+    depends only on (old_world, new_world), never on which physical chips
+    remain.
+    """
+    flat = list(mesh.devices.flat)
+    survivors = [d for r, d in enumerate(flat) if r not in set(exclude)]
+    if new_world > len(survivors):
+        raise ValueError(f"cannot shrink to world {new_world}: only "
+                         f"{len(survivors)} of {len(flat)} devices survive")
+    if new_world < 1:
+        raise ValueError(f"new world must be >= 1, got {new_world}")
+    return Mesh(np.asarray(survivors[:new_world]), (DATA_AXIS,))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for [global_batch, ...] arrays: split dim 0 over the mesh."""
     return NamedSharding(mesh, P(DATA_AXIS))

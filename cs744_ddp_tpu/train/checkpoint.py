@@ -56,6 +56,33 @@ def _atomic_write_json(path: str, obj) -> None:
             os.unlink(tmp)
 
 
+# Config keys an ELASTIC resume is allowed to change: the whole point of
+# the elastic layer is resuming at a different world size (and, under weak
+# scaling, a rescaled global batch) — see cs744_ddp_tpu/elastic/.
+_ELASTIC_FREE_KEYS = ("world", "global_batch")
+
+
+def read_epoch_meta(directory: str) -> Optional[dict]:
+    """The elastic metadata sidecar of the latest EPOCH save (world,
+    global_batch, protocol, data order, per-rank keys), or None.  A
+    standalone reader: the elastic coordinator re-derives membership from
+    disk after ``coordinator_loss`` without constructing a manager."""
+    path = os.path.join(os.path.abspath(directory), "epoch_meta.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def read_mid_epoch_meta(directory: str) -> Optional[dict]:
+    """The mid-epoch (emergency) checkpoint's metadata sidecar, or None."""
+    path = os.path.join(os.path.abspath(directory), "mid_epoch_meta.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
 class CheckpointManager:
     """Thin orbax CheckpointManager wrapper keyed on completed epochs.
 
@@ -64,13 +91,20 @@ class CheckpointManager:
     directory already holds one — restoring foreign state (different model,
     seed, precision) either deep-fails inside orbax with an opaque shape
     error or, worse, silently resumes from the wrong run; this turns both
-    into an immediate, explicit error."""
+    into an immediate, explicit error.
+
+    ``elastic=True`` relaxes exactly the two keys a world-resize resume
+    legitimately changes (``world``, ``global_batch``) from the equality
+    check — every other mismatch still fails.  The on-disk config is NOT
+    rewritten: it keeps recording the run's ORIGINAL topology, and the
+    elastic metadata sidecars carry the per-save truth."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 config: Optional[dict] = None):
+                 config: Optional[dict] = None, *, elastic: bool = False):
         directory = os.path.abspath(directory)
         self._dir = directory
         self._mid = None  # lazy orbax manager for mid-epoch checkpoints
+        self._elastic = elastic
         self._config_path = os.path.join(directory, "trainer_config.json")
         if config is not None:
             config = {**config,
@@ -103,7 +137,7 @@ class CheckpointManager:
                     f"{STATE_FORMAT_VERSION}; checkpoints do not survive "
                     f"TrainState structure changes — delete the directory "
                     f"to start fresh")
-            if existing != config:
+            if self._config_view(existing) != self._config_view(config):
                 raise ValueError(
                     f"checkpoint dir {directory} belongs to a different "
                     f"training config: saved={existing}, current={config}")
@@ -138,7 +172,7 @@ class CheckpointManager:
             except FileExistsError:
                 with open(self._config_path) as f:
                     existing = json.load(f)
-                if existing != config:
+                if self._config_view(existing) != self._config_view(config):
                     raise ValueError(
                         f"checkpoint dir {directory} was concurrently "
                         f"claimed by a different training config: "
@@ -151,14 +185,39 @@ class CheckpointManager:
                 if os.path.exists(tmp):
                     os.unlink(tmp)
 
+    def _config_view(self, cfg: dict) -> dict:
+        """The config as compared: under elastic mode the world-resize
+        keys are excluded from equality (both sides symmetrically)."""
+        if not self._elastic:
+            return cfg
+        return {k: v for k, v in cfg.items() if k not in _ELASTIC_FREE_KEYS}
+
     def latest_epoch(self) -> Optional[int]:
         """Last COMPLETED epoch saved, or None if no checkpoint exists."""
         return self._mngr.latest_step()
 
-    def save(self, epoch: int, state: TrainState) -> None:
-        """Persist state after ``epoch`` completed; blocks until durable."""
+    def _epoch_meta_path(self) -> str:
+        return os.path.join(self._dir, "epoch_meta.json")
+
+    def save(self, epoch: int, state: TrainState,
+             meta: Optional[dict] = None) -> None:
+        """Persist state after ``epoch`` completed; blocks until durable.
+
+        ``meta`` (elastic): topology/data-order sidecar for the LATEST
+        epoch save — world, global_batch, protocol, per-rank data-order
+        keys — written atomically after the checkpoint is durable so the
+        sidecar can never describe a save that doesn't exist."""
         self._mngr.save(epoch, args=ocp.args.StandardSave(state))
         self._mngr.wait_until_finished()
+        if meta is not None:
+            _atomic_write_json(self._epoch_meta_path(),
+                               {**meta, "epoch": epoch})
+
+    def epoch_meta(self) -> Optional[dict]:
+        return read_epoch_meta(self._dir)
+
+    def mid_epoch_meta(self) -> Optional[dict]:
+        return read_mid_epoch_meta(self._dir)
 
     def restore(self, state_like: TrainState,
                 epoch: Optional[int] = None) -> Tuple[TrainState, int]:

@@ -36,7 +36,7 @@ import numpy as np
 from .. import models as model_zoo
 from ..data import cifar10, native, sharding
 from ..ft import (FTConfig, ChaosError, NULL_CHAOS, NonFiniteError,
-                  PreemptedError, PreemptionGuard)
+                  PreemptedError, PreemptionGuard, RankDeathError)
 from ..ft import guard as ftguard
 from ..ft import supervisor as ftsup
 from ..obs import NULL, git_sha
@@ -123,7 +123,8 @@ class Trainer:
                  limit_eval_batches: Optional[int] = None,
                  log: Callable[[str], None] = print,
                  telemetry=NULL,
-                 ft: Optional[FTConfig] = None):
+                 ft: Optional[FTConfig] = None,
+                 elastic=None):
         self.mesh = mesh if mesh is not None else meshlib.make_mesh(num_devices)
         self.world = self.mesh.devices.size
         if global_batch % self.world:
@@ -205,6 +206,43 @@ class Trainer:
         self._verify_chunks = bool(ft is not None and (
             ft.verify_chunks or self.chaos.steps("corrupt_slot")))
         self.staging_degraded = bool(ft is not None and ft.degrade_staging)
+
+        # Elastic mode (elastic/): accepts an ElasticConfig or a protocol
+        # name.  "weak" pins the per-chip batch and only changes resume
+        # PLANNING (the standard per-rank programs already are the weak-
+        # scaling semantics); "strong" pins the global batch and swaps the
+        # train window for the microshard program whose update is bitwise
+        # world-invariant (elastic/step_elastic.py).
+        from ..elastic.protocol import ElasticConfig, PROTOCOLS
+        if isinstance(elastic, str):
+            elastic = ElasticConfig(protocol=elastic)
+        self.elastic = elastic
+        self.rank_death = None      # (rank, epoch, step) after a death
+        self.resume_plan = None     # ResumePlan from an elastic resume
+        self._straggler = None      # lazily-built StragglerDetector
+        if elastic is not None:
+            if elastic.protocol not in PROTOCOLS:
+                raise ValueError(f"elastic protocol must be one of "
+                                 f"{PROTOCOLS}, got {elastic.protocol!r}")
+            if elastic.protocol == "strong":
+                s = elastic.microshards
+                if global_batch % s:
+                    raise ValueError(
+                        f"elastic strong scaling: global batch "
+                        f"{global_batch} not divisible by microshards {s}")
+                if host_augment:
+                    raise ValueError(
+                        "elastic strong scaling requires device-side "
+                        "augmentation (host streams are rank-shaped)")
+                if profile_phases:
+                    raise ValueError(
+                        "elastic strong scaling is windowed-only; "
+                        "profile_phases uses the per-step programs")
+                if self._guard_on or self._nf_chaos_steps:
+                    raise ValueError(
+                        "elastic strong scaling does not support the "
+                        "non-finite guard (the pinned window carries no "
+                        "guarded variant)")
         self.preempted = False
         self._preempt_guard: Optional[PreemptionGuard] = None
         self._rollback = None            # host snapshot for policy=restore
@@ -266,6 +304,17 @@ class Trainer:
             self.apply_fn, strat, self.mesh, sgd_cfg, augment=augment,
             compute_dtype=compute_dtype, nonfinite_guard=self._guard_on,
             nonfinite_chaos_steps=self._nf_chaos_steps)
+        if elastic is not None and elastic.protocol == "strong":
+            # The pinned-math window replaces BOTH the strategy's gradient
+            # reduction and the windowed program: its gather + fixed-tree
+            # combine is the one float summation order every world size
+            # shares (elastic/step_elastic.py) — the strategy choice still
+            # names the NON-elastic programs (tail/eval/per-step).
+            from ..elastic.step_elastic import make_elastic_train_window
+            self.train_window = make_elastic_train_window(
+                self.apply_fn, self.mesh, sgd_cfg,
+                microshards=elastic.microshards, augment=augment,
+                compute_dtype=compute_dtype)
         if host_augment:
             self.train_step_host = steplib.make_train_step(
                 self.apply_fn, strat, self.mesh, sgd_cfg, augment="host",
@@ -345,6 +394,9 @@ class Trainer:
                 "augment": augment,
                 "host_augment": host_augment,
                 "host_chunks": host_chunks,
+                "elastic": (None if elastic is None else
+                            {"protocol": elastic.protocol,
+                             "microshards": elastic.microshards}),
                 "profile_phases": profile_phases,
                 "seed": seed,
                 "reshuffle_each_epoch": reshuffle_each_epoch,
@@ -513,6 +565,51 @@ class Trainer:
         if g is not None and g.requested:
             raise PreemptedError(epoch, step)
 
+    def _rank_boundary(self, epoch: int, step: int, per_iter: float) -> None:
+        """Window-boundary rank bookkeeping (elastic/ft): per-rank
+        step-time gauges, straggler detection, and the rank-level chaos
+        sites.  On this single-process SPMD runtime every rank's honest
+        step time IS the shared window wall time (one program, lockstep);
+        the gauges exist so the attribution seam is real — the
+        ``slow_rank`` site injects a stall attributed to exactly one
+        rank's gauge, which the detector must flag, and on a multi-process
+        deployment the same gauges would carry genuinely distinct times.
+        ``rank_death`` raises ``RankDeathError`` here — a step boundary,
+        so ``step`` batches are exactly what the emergency checkpoint
+        records.  No-op (and allocation-free) without ft/elastic."""
+        if self.elastic is None and not self._supervise:
+            return
+        stalls = {}
+        if self.chaos.enabled and self.chaos.fire_reached("slow_rank", step):
+            planned = self.chaos.fired[-1][1]
+            rank = self.chaos.seed_of("slow_rank", planned)
+            stall_s = (self.ft.slow_rank_stall_s if self.ft is not None
+                       else FTConfig().slow_rank_stall_s)
+            self._record_chaos("slow_rank", step)
+            time.sleep(stall_s)   # the rank really straggles: wall time too
+            stalls[rank] = stall_s
+        if self._straggler is None:
+            from ..elastic.straggler import StragglerDetector
+            self._straggler = StragglerDetector(self.world)
+        for r in range(self.world):
+            t = per_iter + stalls.get(r, 0.0)
+            if self.telemetry.enabled:
+                self.telemetry.gauge("rank_step_time_s", t, rank=r,
+                                     epoch=epoch, step=step)
+            self._straggler.observe(r, t)
+        for r in self._straggler.check():
+            self.log(f"elastic: rank {r} straggling "
+                     f"(EWMA {self._straggler.ewma(r):.3f}s vs peers)")
+            if self.telemetry.enabled:
+                self.telemetry.counter("straggler_flagged", 1, rank=r,
+                                       epoch=epoch, step=step)
+        if self.chaos.enabled and \
+                self.chaos.fire_reached("rank_death", step):
+            planned = self.chaos.fired[-1][1]
+            rank = self.chaos.seed_of("rank_death", planned)
+            self._record_chaos("rank_death", step)
+            raise RankDeathError(rank, epoch, step)
+
     # -- dataset splits (generation-tracked for staging-cache keys) ---------
 
     @property
@@ -583,6 +680,8 @@ class Trainer:
         if self._staged_train is not None and \
                 self._staged_train[0] == cache_key:
             return self._staged_train[1]
+        if self.elastic is not None and self.elastic.protocol == "strong":
+            return self._stage_train_epoch_canonical(epoch, cache_key)
         imgs, labs = [], []
         tail = None
         for i, l in _shard_batches(
@@ -611,6 +710,33 @@ class Trainer:
                         np.zeros((0, self.global_batch), np.int32),
                         self._epoch_sharding))
         staged = (full[0], full[1], tail)
+        self._staged_train = (cache_key, staged)
+        return staged
+
+    def _stage_train_epoch_canonical(self, epoch: int, cache_key):
+        """Elastic strong-scaling staging: batch b is canonical positions
+        [b*B, (b+1)*B) IN ORDER — contiguous microshards, so sharding dim 1
+        over the mesh hands rank r of world M exactly its S/M microshards
+        at every M.  The epoch is wrap-padded to FULL global batches (torch
+        tiling, ``canonical_epoch_order``): the pinned window has no ragged
+        variant, and padding must not depend on the world size."""
+        split = self.train_split
+        n = len(split.labels)
+        nb = -(-n // self.global_batch)              # ceil: pad, don't drop
+        if self.limit_train_batches is not None:
+            nb = min(nb, self.limit_train_batches)
+        order = sharding.canonical_epoch_order(
+            n, seed=self.seed, shuffle=True, epoch=epoch,
+            reshuffle_each_epoch=self.reshuffle_each_epoch,
+            pad_to=nb * self.global_batch)
+        idx = order[:nb * self.global_batch]
+        imgs = native.gather(split.images, idx).reshape(
+            (nb, self.global_batch, 32, 32, 3))
+        labs = split.labels[idx].astype(np.int32).reshape(
+            (nb, self.global_batch))
+        staged = (meshlib.put_global(imgs, self._epoch_sharding),
+                  meshlib.put_global(labs, self._epoch_sharding),
+                  None)
         self._staged_train = (cache_key, staged)
         return staged
 
@@ -736,6 +862,7 @@ class Trainer:
             start += w
             if oks is not None:
                 self._handle_nonfinite(oks, epoch)
+            self._rank_boundary(epoch, start, per_iter)
             self._check_preempt(epoch, start)
         if tail is not None and start_step <= nbatches:
             # The ragged final batch (drop_last=False parity) through its
@@ -1495,6 +1622,7 @@ class Trainer:
             trained += w
             if oks is not None:
                 self._handle_nonfinite(oks, epoch)
+            self._rank_boundary(epoch, trained, per_iter)
             self._check_preempt(epoch, trained)
         self.last_epoch_timers = timers
         return timers
@@ -1557,6 +1685,69 @@ class Trainer:
                  .format(avg_loss, correct, n, acc))
         return avg_loss, correct, acc
 
+    def _elastic_meta(self, epoch: int) -> dict:
+        """Topology + data-order metadata written into every checkpoint
+        sidecar (round 6): enough for ``elastic.protocol.plan_resume`` to
+        map saved progress onto a DIFFERENT world size, plus per-rank
+        data-order keys so a dataset/seed drift under the checkpoint fails
+        loudly at resume time instead of silently desynchronizing the
+        example stream.  Written for every run, elastic or not — that is
+        the forward-compat half of the story (old checkpoints without it
+        restore as world=1 via ``elastic.protocol.world_of``)."""
+        from ..elastic.protocol import rank_data_keys
+        meta = {
+            "world": self.world,
+            "global_batch": self.global_batch,
+            "seed": self.seed,
+            "reshuffle_each_epoch": self.reshuffle_each_epoch,
+            "rank_keys": list(rank_data_keys(
+                len(self.train_split.labels), self.world, seed=self.seed,
+                epoch=epoch,
+                reshuffle_each_epoch=self.reshuffle_each_epoch)),
+        }
+        if self.elastic is not None:
+            meta["protocol"] = self.elastic.protocol
+            if self.elastic.protocol == "strong":
+                meta["microshards"] = self.elastic.microshards
+        return meta
+
+    def _data_order_meta(self, epoch: int, step: int) -> dict:
+        """The mid-epoch sidecar's ``data_order`` payload: the historical
+        resume keys plus the round-6 topology metadata."""
+        return {
+            "seed": self.seed, "epoch": epoch, "step": step,
+            "reshuffle_each_epoch": self.reshuffle_each_epoch,
+            **self._elastic_meta(epoch),
+        }
+
+    def _plan_elastic_resume(self, meta: Optional[dict],
+                             start_step: int) -> int:
+        """Map a mid-epoch checkpoint's progress onto THIS trainer's world
+        size.  Strong scaling carries the step counter over unchanged
+        (batch b covers the same canonical positions at every world); weak
+        scaling re-derives it from example progress.  Validates the saved
+        per-rank data-order keys against this dataset/seed first."""
+        from ..elastic.protocol import (flat_meta, plan_resume,
+                                        validate_rank_keys)
+        flat = flat_meta(meta)
+        if not flat:
+            return start_step
+        validate_rank_keys(flat, len(self.train_split.labels))
+        plan = plan_resume(
+            flat, self.world, protocol=self.elastic.protocol,
+            microshards=(self.elastic.microshards
+                         if self.elastic.protocol == "strong" else None),
+            default_global_batch=self.global_batch)
+        self.resume_plan = plan
+        if plan.old_world != plan.new_world:
+            self.log(
+                f"elastic: resuming world {plan.old_world} -> "
+                f"{plan.new_world} ({plan.protocol}); start step "
+                f"{start_step} -> {plan.start_step}"
+                + (f", {plan.examples_replayed} example(s) replayed"
+                   if plan.examples_replayed else ""))
+        return plan.start_step
+
     def run(self, epochs: int = 1,
             checkpoint_dir: Optional[str] = None,
             profile_dir: Optional[str] = None) -> None:
@@ -1602,7 +1793,8 @@ class Trainer:
                 "weight_decay": self.sgd_cfg.weight_decay,
                 "limit_train_batches": self.limit_train_batches,
                 "real_data": self.real_data,
-                "state_digest": str(param_tree)})
+                "state_digest": str(param_tree)},
+                elastic=self.elastic is not None)
             # Mid-epoch (emergency) checkpoints outrank the epoch series
             # exactly when they are AHEAD of it: the emergency save for
             # epoch k is newer than the epoch k-1 save it coexists with,
@@ -1613,6 +1805,9 @@ class Trainer:
             if mid is not None and (le is None or mid[0] > le):
                 self.state, start_epoch, start_step = \
                     mngr.restore_mid_epoch(self.state)
+                if self.elastic is not None:
+                    start_step = self._plan_elastic_resume(
+                        mngr.mid_epoch_meta(), start_step)
                 self.log(f"Resumed from mid-epoch checkpoint: epoch "
                          f"{start_epoch}, step {start_step}")
             elif le is not None:
@@ -1646,19 +1841,34 @@ class Trainer:
                                                  epoch=e.epoch, step=e.step):
                             mngr.save_mid_epoch(
                                 e.epoch, e.step, self.state,
-                                data_order={
-                                    "seed": self.seed,
-                                    "epoch": e.epoch,
-                                    "step": e.step,
-                                    "reshuffle_each_epoch":
-                                        self.reshuffle_each_epoch,
-                                })
+                                data_order=self._data_order_meta(
+                                    e.epoch, e.step))
                         self.log(f"Preempted at epoch {e.epoch} step "
                                  f"{e.step}; emergency checkpoint saved")
                     else:
                         self.log(f"Preempted at epoch {e.epoch} step "
                                  f"{e.step}; no checkpoint dir — progress "
                                  f"since the last save is lost")
+                    return
+                except RankDeathError as e:
+                    if self.telemetry.enabled:
+                        self.telemetry.counter("rank_deaths", rank=e.rank,
+                                               epoch=e.epoch, step=e.step)
+                    if mngr is not None:
+                        with self.telemetry.span("checkpoint_save_mid_epoch",
+                                                 epoch=e.epoch, step=e.step):
+                            mngr.save_mid_epoch(
+                                e.epoch, e.step, self.state,
+                                data_order=self._data_order_meta(
+                                    e.epoch, e.step))
+                        self.log(f"Rank {e.rank} died at epoch {e.epoch} "
+                                 f"step {e.step}; emergency checkpoint "
+                                 f"saved")
+                    else:
+                        self.log(f"Rank {e.rank} died at epoch {e.epoch} "
+                                 f"step {e.step}; no checkpoint dir — "
+                                 f"progress since the last save is lost")
+                    self.rank_death = (e.rank, e.epoch, e.step)
                     return
                 start_step = 0
                 self.log(f"Training time after {epoch + 1} epoch is "
@@ -1670,7 +1880,8 @@ class Trainer:
                 self.test_model()
                 if mngr is not None:
                     with self.telemetry.span("checkpoint_save", epoch=epoch):
-                        mngr.save(epoch, self.state)
+                        mngr.save(epoch, self.state,
+                                  meta=self._elastic_meta(epoch))
                     mngr.clear_mid_epoch()
                     if self._nf_policy == "restore":
                         self._snapshot_rollback()   # advance rollback point

@@ -708,3 +708,62 @@ def test_watchdog_cancel_vs_fire_race():
     assert wd2.fired and len(fired) == 1
     wd2._fire()           # late duplicate after exit: still inert
     assert len(fired) == 1
+
+
+_SRC_DECLARED = """\
+import threading
+
+class Coord:
+    _lock_owned = ("world", "members")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.world = 4
+        self.members = (0, 1, 2, 3)
+
+    def shrink(self):
+        self.world = 1
+"""
+
+
+def test_lint_lock_owned_declaration_guards_from_first_write():
+    """A class-level ``_lock_owned`` tuple declares attributes lock-owned
+    even when NO locked write is in view — a new method mutating them
+    unlocked fails before any locked counterpart exists (the elastic
+    coordinator's membership contract)."""
+    bad = pylint_rules.lint_source(_SRC_DECLARED, "bad.py")
+    assert [f.rule for f in bad] == ["lock-ownership"]
+    assert "shrink" in bad[0].message and "world" in bad[0].message
+    ok = _SRC_DECLARED.replace(
+        "    def shrink(self):\n        self.world = 1",
+        "    def shrink(self):\n        with self._lock:\n"
+        "            self.world = 1")
+    assert pylint_rules.lint_source(ok, "ok.py") == []
+    # Undeclared attributes keep the heuristic-only semantics: a write
+    # that is never locked anywhere is not flagged.
+    free = _SRC_DECLARED.replace('("world", "members")', '("members",)')
+    assert pylint_rules.lint_source(free, "free.py") == []
+    # __init__ stays exempt (construction happens-before sharing), and
+    # non-literal declaration elements are ignored, not crashed on.
+    dynamic = _SRC_DECLARED.replace('("world", "members")',
+                                    '("members",) + EXTRA')
+    assert pylint_rules.lint_source(
+        "EXTRA = ()\n" + dynamic, "dyn.py") == []
+
+
+def test_lint_lock_owned_declaration_needs_a_lock():
+    # Without a lock attribute the rule (and the declaration) is inert.
+    no_lock = "class C:\n    _lock_owned = ('x',)\n" \
+              "    def f(self):\n        self.x = 1\n"
+    assert pylint_rules.lint_source(no_lock, "n.py") == []
+
+
+def test_zoo_shrunk_world_audits_clean():
+    """Round 6: the program set the elastic ladder degrades INTO (world 2
+    and the world-1 synchronous fallback) certifies against the same cost
+    contracts as the full mesh — ``--audit-zoo`` passes for shrunk worlds."""
+    for ndev in (2, 1):
+        res = auditlib.audit_zoo(model="tiny", global_batch=64, window=3,
+                                 strategies=("ddp",), paths=("window",),
+                                 include_eval=False, num_devices=ndev)
+        assert res.clean, "\n".join(res.format_lines())

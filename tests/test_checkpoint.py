@@ -148,3 +148,121 @@ def test_unstamped_checkpoint_dir_accepted_as_current_version(tmp_path,
     # The one-time migration stamped the dir.
     with open(cfg_path) as f:
         assert json.load(f)["state_format_version"] == 2
+
+
+# -- round 6: elastic metadata forward/backward compatibility ----------------
+#
+# Backward: checkpoints written BEFORE the elastic layer carry no topology
+# metadata and must restore as world=1 with a one-time warning.  Forward:
+# the round-6 sidecars must not break old-style (non-elastic) resume, and
+# the elastic config guard relaxes exactly the world/global-batch keys.
+
+def _elastic_make(tmp_path, world, *, ft=None, log=None):
+    import cs744_ddp_tpu.train.loop as looplib
+    from cs744_ddp_tpu.parallel import make_mesh
+    assert looplib.WINDOW == 3, "callers must monkeypatch WINDOW first"
+    return Trainer(model=tiny_cnn(), strategy="allreduce",
+                   mesh=make_mesh(world), global_batch=64,
+                   data_dir=str(tmp_path), seed=3, augment=True,
+                   limit_train_batches=6, limit_eval_batches=1,
+                   log=log or (lambda s: None), ft=ft, elastic="strong")
+
+
+def test_pre_elastic_mid_epoch_checkpoint_resumes_world1_warns(
+        tmp_path, monkeypatch):
+    import json
+    import os
+
+    import pytest
+
+    import cs744_ddp_tpu.train.loop as looplib
+    from cs744_ddp_tpu.elastic import protocol as protolib
+    from cs744_ddp_tpu.ft import ChaosPlan, FTConfig
+    monkeypatch.setattr(looplib, "WINDOW", 3)
+
+    ck = str(tmp_path / "ck")
+    tr1 = _elastic_make(tmp_path, 1,
+                        ft=FTConfig(chaos=ChaosPlan.parse(["preempt:3"])))
+    tr1.run(1, checkpoint_dir=ck)
+    assert tr1.preempted is True
+
+    # Rewrite the mid-epoch sidecar into its pre-round-6 shape: resume
+    # keys only, no world/global_batch/rank_keys.
+    meta_path = os.path.join(ck, "mid_epoch_meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    order = meta["data_order"]
+    meta["data_order"] = {k: order[k] for k in
+                          ("seed", "epoch", "step", "reshuffle_each_epoch")}
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+
+    monkeypatch.setattr(protolib, "_warned_missing_world", False)
+    lines = []
+    tr2 = _elastic_make(tmp_path, 1, log=lines.append)
+    with pytest.warns(UserWarning, match="no world size"):
+        tr2.run(1, checkpoint_dir=ck)
+    assert any("Resumed from mid-epoch checkpoint: epoch 0, step 3" in l
+               for l in lines)
+    assert tr2.resume_plan.old_world == 1          # the compat default
+    assert tr2.resume_plan.start_step == 3
+
+    # Bitwise vs a never-interrupted run of the same elastic config.
+    tr0 = _elastic_make(tmp_path, 1)
+    tr0.run(1)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        tr2.state, tr0.state)
+
+
+def test_elastic_checkpoint_readable_by_non_elastic_trainer(tmp_path,
+                                                            monkeypatch):
+    """Forward direction: the round-6 epoch sidecar rides ALONGSIDE the
+    state — an old-style (non-elastic) trainer of the same config resumes
+    it without noticing."""
+    import cs744_ddp_tpu.train.loop as looplib
+    monkeypatch.setattr(looplib, "WINDOW", 3)
+
+    ck = str(tmp_path / "ck")
+    tr1 = _elastic_make(tmp_path, 1)
+    tr1.run(1, checkpoint_dir=ck)
+
+    from cs744_ddp_tpu.parallel import make_mesh
+    lines = []
+    tr2 = Trainer(model=tiny_cnn(), strategy="allreduce",
+                  mesh=make_mesh(1), global_batch=64,
+                  data_dir=str(tmp_path), seed=3, augment=True,
+                  limit_train_batches=6, limit_eval_batches=1,
+                  log=lines.append)
+    tr2.run(2, checkpoint_dir=ck)                  # must resume, not raise
+    assert any("Resumed from checkpoint: epoch 1" in l for l in lines)
+
+
+def test_elastic_config_guard_frees_world_nonelastic_still_rejects(
+        tmp_path, monkeypatch):
+    import pytest
+
+    import cs744_ddp_tpu.train.loop as looplib
+    monkeypatch.setattr(looplib, "WINDOW", 3)
+
+    ck = str(tmp_path / "ck")
+    tr1 = _elastic_make(tmp_path, 2)
+    tr1.run(1, checkpoint_dir=ck)
+
+    # Elastic manager: a world change is exactly what resume is FOR.
+    lines = []
+    tr2 = _elastic_make(tmp_path, 1, log=lines.append)
+    tr2.run(2, checkpoint_dir=ck)
+    assert any("Resumed from checkpoint: epoch 1" in l for l in lines)
+
+    # Non-elastic manager over the same dir: the world key is back in the
+    # config equality, so the mismatch fails loudly.
+    from cs744_ddp_tpu.parallel import make_mesh
+    tr3 = Trainer(model=tiny_cnn(), strategy="allreduce",
+                  mesh=make_mesh(1), global_batch=64,
+                  data_dir=str(tmp_path), seed=3, augment=True,
+                  limit_train_batches=6, limit_eval_batches=1,
+                  log=lambda s: None)
+    with pytest.raises(ValueError, match="different training config"):
+        tr3.run(2, checkpoint_dir=ck)

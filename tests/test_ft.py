@@ -26,10 +26,13 @@ import pytest
 
 import cs744_ddp_tpu.train.loop as looplib
 from cs744_ddp_tpu.data import cifar10
-from cs744_ddp_tpu.ft import (NULL_CHAOS, ChaosPlan, FTConfig,
-                              NonFiniteError, NullChaos, StagingStalled,
-                              Watchdog, batch_checksums, call_with_retry,
+from cs744_ddp_tpu.elastic import ElasticCoordinator
+from cs744_ddp_tpu.ft import (NULL_CHAOS, RANK_SITES, SITES, ChaosPlan,
+                              FTConfig, NonFiniteError, NullChaos,
+                              RankDeathError, StagingStalled, Watchdog,
+                              batch_checksums, call_with_retry,
                               verify_checksums)
+from cs744_ddp_tpu.parallel import make_mesh
 from cs744_ddp_tpu.obs.telemetry import atomic_write_json, read_events_jsonl
 from cs744_ddp_tpu.train.checkpoint import CheckpointManager
 from cs744_ddp_tpu.train.loop import Trainer
@@ -600,3 +603,144 @@ def test_sigterm_subprocess_emergency_checkpoint_and_resume(
     tr0 = small_eval(_trainer(tmp_path, mesh4, limit=45))
     tr0.run(1)
     _assert_bitwise(_host_state(tr2), _host_state(tr0))
+
+
+# -- integration: rank-level chaos + the elastic degradation ladder -----------
+#
+# New round-6 sites: rank_death / slow_rank target a RANK (the spec's third
+# field), coordinator_loss targets the coordinator's recovery progress.
+# Every recovery that promises to preserve the stream stays BITWISE.
+
+def test_chaos_rank_sites_target_ranks_one_shot():
+    assert RANK_SITES == ("rank_death", "slow_rank")
+    assert "coordinator_loss" in SITES
+    plan = ChaosPlan.parse(["rank_death:3:1", "slow_rank:5:2",
+                            "coordinator_loss:0"])
+    # The third field is the target rank, carried in the seed slot.
+    assert plan.seed_of("rank_death", 3) == 1
+    assert plan.seed_of("slow_rank", 5) == 2
+    assert plan.fire_reached("rank_death", 4)      # >= planned step
+    assert not plan.fire_reached("rank_death", 9)  # one-shot
+    assert plan.fire_reached("coordinator_loss", 0)
+    err = RankDeathError(1, 0, 3)
+    assert (err.rank, err.epoch, err.step) == (1, 0, 3)
+
+
+def _small_eval(tr):
+    tr.test_split = cifar10.Split(tr.test_split.images[:64],
+                                  tr.test_split.labels[:64])
+    return tr
+
+
+def test_rank_death_emergency_checkpoint_same_world_resume_bitwise(
+        tmp_path, mesh4, small_window):
+    """Rank death mid-epoch -> emergency mid-epoch checkpoint (with the
+    round-6 topology metadata) -> a same-world resume finishes the epoch
+    bitwise identical to an undisturbed run (the coordinator's retry rung
+    is exactly this plain resume)."""
+    clean = _clean_state(tmp_path, mesh4)
+    ck = str(tmp_path / "ck_rd")
+    plan = ChaosPlan.parse(["rank_death:3:1"])
+    lines = []
+    tr = _small_eval(_trainer(tmp_path, mesh4, ft=FTConfig(chaos=plan),
+                              log=lines.append))
+    tr.run(1, checkpoint_dir=ck)
+    assert tr.rank_death == (1, 0, 3)
+    assert ("rank_death", 3) in plan.fired
+    assert any("Rank 1 died at epoch 0 step 3" in ln for ln in lines)
+
+    from cs744_ddp_tpu.elastic import flat_meta
+    from cs744_ddp_tpu.train.checkpoint import read_mid_epoch_meta
+    meta = flat_meta(read_mid_epoch_meta(ck))
+    assert meta["world"] == 4 and meta["step"] == 3
+    assert len(meta["rank_keys"]) == 4
+
+    lines2 = []
+    tr2 = _small_eval(_trainer(tmp_path, mesh4, log=lines2.append))
+    tr2.run(1, checkpoint_dir=ck)
+    assert any("Resumed from mid-epoch checkpoint: epoch 0, step 3" in ln
+               for ln in lines2)
+    assert tr2.rank_death is None
+    _assert_bitwise(_host_state(tr2), clean)
+
+
+def _elastic_trainer(tmp_path, world, *, ft=None, log=None, limit=6):
+    return Trainer(model=tiny_cnn(), strategy="allreduce",
+                   mesh=make_mesh(world), global_batch=64,
+                   data_dir=str(tmp_path), seed=3, augment=True,
+                   limit_train_batches=limit, limit_eval_batches=1,
+                   log=log or (lambda s: None), ft=ft, elastic="strong")
+
+
+def test_rank_death_ladder_shrinks_and_recovery_is_bitwise(tmp_path,
+                                                           small_window):
+    """ISSUE round 6 acceptance: a chaos-injected mid-epoch rank death at
+    world 2 drives the coordinator down the ladder (emergency checkpoint ->
+    shrink -> resume at world 1), and the recovered run's final state is
+    BITWISE equal to a fault-free run at the target world — the strong-
+    scaling world-invariance pin cashed in as a recovery guarantee."""
+    tr0 = _elastic_trainer(tmp_path, 1)            # fault-free world-1 ref
+    tr0.run(1)
+
+    plan = ChaosPlan.parse(["rank_death:3:1"])
+    lines = []
+    coord = ElasticCoordinator(
+        lambda w: _elastic_trainer(tmp_path, w, ft=FTConfig(chaos=plan),
+                                   log=lines.append),
+        world=2, global_batch=64, microshards=4, chaos=plan,
+        log=lines.append)
+    tr = coord.run(1, str(tmp_path / "ck_ladder"))
+
+    assert [e["kind"] for e in coord.events] == ["shrink"]
+    assert any("shrinking world 2 -> 1" in ln for ln in lines)
+    rep = coord.report()
+    assert rep["world"] == 1 and rep["degraded"] is True
+    assert rep["generation"] == 1 and len(rep["members"]) == 1
+    plan_r = tr.resume_plan
+    assert (plan_r.old_world, plan_r.new_world) == (2, 1)
+    assert plan_r.start_step == 3                  # strong: step carries
+    assert plan_r.examples_replayed == 0
+    _assert_bitwise(_host_state(tr), _host_state(tr0))
+
+
+def test_coordinator_loss_rederives_membership_from_disk_bitwise(
+        tmp_path, small_window):
+    """The coordinator_loss site drops the in-memory membership mid-
+    recovery; the coordinator must re-derive it from checkpoint metadata
+    alone and still land the same bitwise-pinned shrink."""
+    tr0 = _elastic_trainer(tmp_path, 1)
+    tr0.run(1)
+
+    plan = ChaosPlan.parse(["rank_death:3:1", "coordinator_loss:0"])
+    lines = []
+    coord = ElasticCoordinator(
+        lambda w: _elastic_trainer(tmp_path, w, ft=FTConfig(chaos=plan),
+                                   log=lines.append),
+        world=2, global_batch=64, microshards=4, chaos=plan,
+        log=lines.append)
+    tr = coord.run(1, str(tmp_path / "ck_closs"))
+
+    assert any("re-deriving from checkpoint metadata" in ln for ln in lines)
+    assert ("coordinator_loss", 0) in plan.fired
+    assert [e["kind"] for e in coord.events] == ["shrink"]
+    assert coord.report()["world"] == 1
+    _assert_bitwise(_host_state(tr), _host_state(tr0))
+
+
+def test_slow_rank_flags_straggler_and_stream_unchanged(tmp_path, mesh4,
+                                                        small_window):
+    """slow_rank injects a real stall attributed to one rank's step-time
+    gauge: the detector must flag exactly that rank, and the training
+    stream must be untouched (detection-only, bitwise pin)."""
+    clean = _clean_state(tmp_path, mesh4)
+    plan = ChaosPlan.parse(["slow_rank:3:2"])
+    lines = []
+    tr = _trainer(tmp_path, mesh4,
+                  ft=FTConfig(chaos=plan, slow_rank_stall_s=2.0),
+                  log=lines.append)
+    tr.train_model(0)
+    assert ("slow_rank", 3) in plan.fired
+    assert any("rank 2 straggling" in ln for ln in lines)
+    assert tr._straggler.flag_counts.get(2, 0) >= 1
+    assert tr.rank_death is None
+    _assert_bitwise(_host_state(tr), clean)

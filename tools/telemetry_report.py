@@ -61,6 +61,45 @@ def _serving_lines(events) -> list:
     return lines
 
 
+def _elastic_lines(events, manifest) -> list:
+    """Elastic-mode rendering (``--elastic`` runs): per-rank step-time
+    percentiles from the raw ``rank_step_time_s`` gauges, straggler flags,
+    and the rank-death count — the report-side face of the round-6
+    world-resize layer.  Returns [] for runs with no elastic signal —
+    non-elastic reports are unchanged."""
+    per, flags, deaths = {}, {}, 0
+    for e in events:
+        kind, name = e.get("kind"), e.get("name")
+        if kind == "gauge" and name == "rank_step_time_s":
+            per.setdefault(e.get("rank", "?"), []).append(e["value"])
+        elif kind == "counter" and name == "straggler_flagged":
+            flags[e.get("rank", "?")] = \
+                flags.get(e.get("rank", "?"), 0) + e.get("inc", 1)
+        elif kind == "counter" and name == "rank_deaths":
+            deaths = e["total"]
+    cfg = (manifest or {}).get("elastic")
+    if not per and not flags and not deaths and not cfg:
+        return []
+    lines = ["== elastic =="]
+    if cfg:
+        proto = cfg.get("protocol")
+        ms = cfg.get("microshards")
+        lines.append(f"  protocol               {proto}"
+                     + (f" (microshards {ms})" if ms else ""))
+    if per:
+        lines.append("  per-rank step time (window-boundary attribution):")
+        for r in sorted(per, key=str):
+            v = per[r]
+            mark = f"  straggler x{flags[r]}" if r in flags else ""
+            lines.append(f"    rank {r!s:<4} x{len(v):<6} "
+                         f"p50 {_fmt_ms(percentile(v, 50)):>12}  "
+                         f"max {_fmt_ms(max(v)):>12}{mark}")
+    if deaths:
+        lines.append(f"  rank deaths            {deaths}")
+    lines.append("")
+    return lines
+
+
 def _audit_lines(manifest) -> list:
     """Program-audit rendering (``--audit`` runs write
     ``manifest["audit"]`` via analysis/audit.py's ``record_audit``):
@@ -156,6 +195,7 @@ def render(out_dir: str) -> str:
         lines.append("")
 
     lines.extend(_serving_lines(events))
+    lines.extend(_elastic_lines(events, manifest))
     lines.extend(_audit_lines(manifest))
 
     gauges = {}
