@@ -41,6 +41,12 @@ tests pin both.  The full payload carries
   * ``spectrum`` — static per-strategy collective counts, comm bytes and
     dependency-chain depths from the TPU v5e-8 AOT lowering (the strategy
     tiers' cost AND latency shapes, independent of wall-clock noise), and
+  * ``compression`` — the round-7 gradient-compression cost sheet
+    (``run_compression``): per-tier MEASURED collective result bytes
+    from the pre-optimization lowering (with the ratio vs the
+    uncompressed per-param tier), interleaved min-over-rounds epoch
+    wall clock, and the convergence delta vs the uncompressed tier
+    after an identical training schedule, and
   * ``host_pipeline`` — chunked windowed ``--host-augment`` throughput
     (the reference's DataLoader-worker model; host->device-link-bound on
     the tunneled bench host, see BASELINE.md), alongside the measured
@@ -839,6 +845,117 @@ def run_elastic(log, *, headline_model: str = "vgg11", ndev=None,
     return out
 
 
+# The compression cost sheet's tiers: the uncompressed controls first
+# (per-param = the byte baseline the ISSUE ratios are against; ddp and
+# overlap share its bytes and differ in schedule), then the lossy tiers.
+COMPRESSION_TIERS = ("allreduce", "ddp", "overlap",
+                     "compress-bf16", "compress-int8", "powersgd")
+
+
+def run_compression(log, *, headline_model: str = "vgg11", ndev=None,
+                    global_batch: int = 256, data_dir: str = "./data",
+                    max_iters: int = 100,
+                    tiers=COMPRESSION_TIERS) -> Optional[dict]:
+    """Compression-tier cost sheet (round 7) on THIS host's mesh:
+
+    * ``comm_result_mib`` — MEASURED collective result bytes from each
+      tier's pre-optimization step lowering (the same accounting the
+      audit's byte contracts certify — static, immune to host noise),
+      with the ratio vs the uncompressed per-param tier,
+    * ``wall_clock_s_best`` / ``images_per_sec_per_chip`` — interleaved
+      min-over-rounds epoch wall clock: each round visits every tier
+      once, so a host-contention burst inflates all tiers equally
+      instead of landing on one entry (the test_spectrum_wallclock
+      noise discipline), and
+    * ``convergence_delta_pct`` — test accuracy after an IDENTICAL
+      warm+timed training schedule per tier, minus the uncompressed
+      ``allreduce`` tier's accuracy: the lossy tiers' accuracy cost,
+      measured rather than promised.
+
+    None (with a logged reason) on a single-device host — every tier's
+    sync is a no-op there, so the sheet would be noise around zero."""
+    import time as _time
+
+    import jax
+
+    from cs744_ddp_tpu.analysis import audit as auditlib
+
+    log = log or (lambda s: print(s, file=sys.stderr))
+    ndev = ndev or len(jax.devices())
+    if ndev < 2:
+        log("[bench] compression: single-device host — tiers collapse to "
+            "no-op sync; section omitted")
+        return None
+
+    # Static comm bytes: one step-path lowering per tier (no compile).
+    try:
+        zoo = auditlib.audit_zoo(
+            model=headline_model, global_batch=global_batch,
+            strategies=tiers, paths=("step",), include_eval=False,
+            num_devices=ndev)
+    except Exception as e:   # noqa: BLE001 - advisory section
+        log(f"[bench] compression: static lowering failed ({e!r}); "
+            "section omitted")
+        return None
+    comm_mib = {
+        r.program.rsplit("/", 1)[-1]:
+            sum(r.stats.get("result_bytes", {}).values()) / 2**20
+        for r in zoo.reports}
+
+    lim = min(max_iters, 30)
+    try:
+        trainers = {}
+        for t in tiers:
+            log(f"[bench] compression: staging {headline_model}/{t} "
+                f"on {ndev} device(s)")
+            trainers[t] = _make_trainer(
+                headline_model, t, ndev, global_batch=global_batch,
+                data_dir=data_dir, log=lambda s: None,
+                limit_train_batches=lim, limit_eval_batches=4)
+        tr0 = trainers[tiers[0]]
+        nfull, tail_per = tr0._per_rank_batch_counts()
+        images = (min(lim, nfull) * global_batch
+                  + (tail_per * tr0.world
+                     if lim > nfull and tail_per else 0))
+        for t in tiers:
+            trainers[t].train_model(0)      # compile + warm
+        best = {t: float("inf") for t in tiers}
+        for _ in range(3):
+            for t in tiers:
+                t0 = _time.time()
+                trainers[t].train_model(0)
+                best[t] = min(best[t], _time.time() - t0)
+        acc = {}
+        for t in tiers:
+            _, _, acc[t] = trainers[t].test_model()
+    except Exception as e:   # noqa: BLE001 - advisory section
+        log(f"[bench] compression: measurement failed ({e!r}); "
+            "section omitted")
+        return None
+
+    base_mib = comm_mib.get("allreduce")
+    out = {
+        "protocol": f"{lim} batches/epoch, 1 warm + 3 interleaved timed "
+                    f"epochs (min over rounds), global batch "
+                    f"{global_batch}, f32",
+        "world": ndev,
+        "baseline_tier": "allreduce",
+        "per_tier": {},
+    }
+    for t in tiers:
+        out["per_tier"][t] = {
+            "wall_clock_s_best": round(best[t], 3),
+            "images_per_sec_per_chip": round(images / best[t] / ndev, 2),
+            "comm_result_mib": round(comm_mib.get(t, 0.0), 4),
+            "comm_ratio_vs_allreduce": (
+                round(base_mib / comm_mib[t], 2)
+                if base_mib and comm_mib.get(t) else None),
+            "test_accuracy_pct": round(acc[t], 2),
+            "convergence_delta_pct": round(acc[t] - acc["allreduce"], 2),
+        }
+    return out
+
+
 def run_audit(log, *, headline_model: str = "vgg11",
               global_batch: int = 256) -> Optional[dict]:
     """Static program audit (``cs744_ddp_tpu/analysis/audit.py``) over the
@@ -874,6 +991,7 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
               peak: bool = True, convergence: bool = True,
               convergence_epochs: int = 3,
               spectrum: bool = True, host_pipeline: bool = True,
+              compression: bool = True,
               robustness: bool = True, serving: bool = True,
               elastic: bool = True,
               audit: bool = True,
@@ -1171,6 +1289,18 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
                 global_batch=global_batch),
         }
 
+    # Compression-tier cost sheet: measured comm bytes, interleaved
+    # wall clock and the convergence delta vs the uncompressed tier
+    # (round 7; the static byte CONTRACTS are certified by the audit
+    # section — this is the measured companion).
+    if compression:
+        comp = run_compression(
+            log, headline_model=headline_model, ndev=ndev,
+            global_batch=global_batch, data_dir=data_dir,
+            max_iters=max_iters)
+        if comp is not None:
+            result["compression"] = comp
+
     # Fault-tolerance cost/benefit: guard overhead, degraded-staging
     # fraction, emergency checkpoint wall clock, skip-policy demo.
     if robustness:
@@ -1348,6 +1478,10 @@ def main(argv=None) -> None:
                         "section (v5e-8 AOT lowering)")
     p.add_argument("--no-host-pipeline", action="store_true",
                    help="skip the windowed --host-augment throughput entry")
+    p.add_argument("--no-compression", action="store_true",
+                   help="skip the compression-tier cost sheet (measured "
+                        "comm bytes, interleaved wall clock, convergence "
+                        "delta vs the uncompressed tier)")
     p.add_argument("--no-robustness", action="store_true",
                    help="skip the fault-tolerance cost/benefit section "
                         "(guard overhead, degraded staging, emergency "
@@ -1397,6 +1531,8 @@ def main(argv=None) -> None:
                        spectrum=not (args.no_spectrum or args.no_matrix),
                        host_pipeline=not (args.no_host_pipeline
                                           or args.no_matrix),
+                       compression=not (args.no_compression
+                                        or args.no_matrix),
                        robustness=not (args.no_robustness
                                        or args.no_matrix),
                        serving=not (args.no_serving or args.no_matrix),

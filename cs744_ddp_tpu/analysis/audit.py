@@ -20,7 +20,17 @@ tests/test_analysis.py):
   >= nleaves all-reduces chained >= nleaves deep; ``ddp`` all-reduces
   chained exactly per-bucket — STRICTLY shallower than per-param when
   there are fewer buckets than leaves (the DDP fusion win, Li et al.,
-  VLDB 2020).  The cross-strategy depth ladder
+  VLDB 2020).  The ``overlap`` tier keeps ddp's bucket count but must
+  lower a chain depth of exactly 1 (no collective consumes another's
+  result — the single post-backward chain is what defeats XLA's
+  latency-hiding scheduler) and at least one bucket's operand cone must
+  exclude part of the backward (``stats.collective_dot_cones``).  The
+  compressed tiers (``compress-bf16`` / ``compress-int8`` /
+  ``powersgd``) must keep their gradient wire bytes under
+  ``param_bytes / compress_ratio`` (+ declared ``aux_bytes`` for BN
+  pmeans, loss psums and the int8 shared-scale pmax): >= 2x / 4x /
+  rank-r low-rank reduction vs the per-param f32 floor, certified on
+  the lowering, not the docs.  The cross-strategy depth ladder
   (ddp < allreduce < gather) is certified whenever several strategies
   are audited together.
 - ``dtype-leak`` — no f32/f64 ``dot``/``convolution`` in a
@@ -72,7 +82,8 @@ class Finding:
 class ProgramContract:
     """What a program's lowering is REQUIRED to look like."""
     name: str
-    strategy: Optional[str] = None       # single/gather/allreduce/ddp/eval;
+    strategy: Optional[str] = None       # single/gather/allreduce/ddp/
+                                         # overlap/compress-*/powersgd/eval;
                                          # None = no collectives expected
     world: int = 1
     nleaves: int = 0                     # parameter (grad) leaves
@@ -82,6 +93,9 @@ class ProgramContract:
     donates_state: bool = False
     precision: str = "f32"
     max_constant_bytes: int = DEFAULT_MAX_CONSTANT_BYTES
+    compress_ratio: float = 1.0          # required param_bytes / grad wire
+    aux_bytes: int = 0                   # non-gradient collective allowance
+                                         # (BN pmean, loss psum, int8 pmax)
 
 
 @dataclass
@@ -194,6 +208,46 @@ def _rule_collective_contract(module: hlo_ir.Module, jaxpr,
         if c.param_bytes and by.get("all-reduce", 0) < c.param_bytes:
             bad(f"all-reduce result bytes {by.get('all-reduce', 0)} < "
                 f"total param bytes {c.param_bytes}")
+        return out
+
+    if c.strategy == "overlap":
+        if ag or others:
+            bad(f"overlapped tier must emit only all-reduce; found {counts}")
+        if ar < c.nbuckets:
+            bad(f"overlapped tier reduces every bucket: {ar} all-reduce < "
+                f"{c.nbuckets} buckets")
+        if depth > 1:
+            bad(f"overlapped tier must not chain collectives: chain depth "
+                f"{depth} > 1 — a single post-backward chain pins every "
+                f"bucket behind the full backward and defeats latency "
+                f"hiding")
+        cones = stats.collective_dot_cones(module)
+        if cones["total_dots"] and cones["min_cone"] >= cones["total_dots"]:
+            bad(f"every collective's operand cone spans all "
+                f"{cones['total_dots']} dots — no bucket reduce can be "
+                f"issued before the backward completes")
+        if c.param_bytes and by.get("all-reduce", 0) < c.param_bytes:
+            bad(f"all-reduce result bytes {by.get('all-reduce', 0)} < "
+                f"total param bytes {c.param_bytes}")
+        return out
+
+    if c.strategy in ("compress-bf16", "compress-int8", "powersgd"):
+        if ag or others:
+            bad(f"compressed tier must emit only all-reduce; found {counts}")
+        if ar < c.nleaves:
+            bad(f"compressed tier reduces every leaf: {ar} all-reduce < "
+                f"{c.nleaves} leaves")
+        wire = by.get("all-reduce", 0)
+        if wire <= 0:
+            bad("compressed tier lowered no all-reduce bytes")
+        if c.param_bytes:
+            grad_wire = max(0, wire - c.aux_bytes)
+            ceiling = c.param_bytes / c.compress_ratio
+            if grad_wire > ceiling:
+                bad(f"compression is not real: gradient wire bytes "
+                    f"{grad_wire} (total all-reduce {wire} - aux "
+                    f"{c.aux_bytes}) exceed param_bytes / "
+                    f"{c.compress_ratio:g}x = {ceiling:.0f}")
         return out
 
     bad(f"unknown strategy {c.strategy!r} in contract")
@@ -327,6 +381,7 @@ def audit_program(hlo_text: str, contract: ProgramContract, jaxpr=None,
     s = stats.collective_stats(module)
     report.stats = {
         "collectives": {op: e["count"] for op, e in s["ops"].items()},
+        "result_bytes": stats.collective_bytes(module),
         "chain_depth": stats.collective_chain_depth(module),
         "donated": module.donated_param_count(),
     }
@@ -448,6 +503,13 @@ def _train_sds(mesh, state_sds, global_batch: int, window: int):
         return jax.ShapeDtypeStruct(sds.shape, sds.dtype, sharding=sharding)
 
     state = jax.tree_util.tree_map(lambda s: share(s, rep), state_sds)
+    comm = getattr(state.opt_state, "comm", None)
+    if comm is not None:
+        # Compression carry-state (error-feedback residuals / PowerSGD
+        # factors) is stacked (world, ...) and lives row-sharded so each
+        # worker owns its slice — mirror the Trainer's placement.
+        state = state._replace(opt_state=state.opt_state._replace(
+            comm=jax.tree_util.tree_map(lambda s: share(s, row), comm)))
     b, w = global_batch, window
     return {
         "state": state,
@@ -471,7 +533,9 @@ def _hlo_text(lowered) -> str:
 def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
               window: int = 4, precision: str = "f32",
               strategies: Sequence[str] = ("single", "gather",
-                                           "allreduce", "ddp"),
+                                           "allreduce", "ddp", "overlap",
+                                           "compress-bf16", "compress-int8",
+                                           "powersgd"),
               paths: Sequence[str] = ("step", "window", "host_window"),
               include_eval: bool = True,
               serve_buckets: Sequence[int] = (),
@@ -506,10 +570,36 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
         jax.random.PRNGKey(0))
     params_sds = state_sds.params
     nleaves = len(jax.tree_util.tree_leaves(params_sds))
-    n_state_leaves = len(jax.tree_util.tree_leaves(state_sds))
     param_bytes = sum(l.size * l.dtype.itemsize
                       for l in jax.tree_util.tree_leaves(params_sds))
     nbuckets = make_plan(params_sds, DEFAULT_BUCKET_BYTES).num_buckets
+    # Non-gradient collective allowance for the compressed-tier byte
+    # ceilings: BN batch-stat pmeans, the int8 shared-scale pmax
+    # (f32[nleaves]) and a slack word for loss/count psums.
+    bn_bytes = sum(l.size * l.dtype.itemsize
+                   for l in jax.tree_util.tree_leaves(state_sds.bn_state))
+    aux_bytes = bn_bytes + 4 * nleaves + 1024
+
+    def _compress_ratio(strategy, strat):
+        """Analytic wire-byte reduction of a compressed tier on THIS
+        model's leaf shapes — exact from the lowering recipe, so the
+        contract pins what the program must achieve, not a slogan."""
+        if strategy == "compress-bf16":
+            return 2.0
+        if strategy == "compress-int8":
+            return 4.0
+        if strategy == "powersgd":
+            wire = 0
+            for l in jax.tree_util.tree_leaves(params_sds):
+                if strat._low_rank(l.shape):
+                    m = 1
+                    for d in l.shape[:-1]:
+                        m *= d
+                    wire += 4 * strat.rank * (m + l.shape[-1])  # f32 P + Q
+                else:
+                    wire += 2 * l.size                          # bf16 path
+            return max(1.0, param_bytes / max(1, wire))
+        return 1.0
 
     full_mesh = meshlib.make_mesh(num_devices)
     single_mesh = meshlib.make_mesh(1)
@@ -518,19 +608,27 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
     result = AuditResult()
     window_depths: Dict[str, int] = {}
 
-    def contract(name, strategy, w, donates):
+    def contract(name, strategy, w, donates, n_state, ratio):
         return ProgramContract(
             name=name, strategy=strategy, world=w, nleaves=nleaves,
             nbuckets=nbuckets, param_bytes=param_bytes,
-            n_state_leaves=n_state_leaves, donates_state=donates,
-            precision=precision, max_constant_bytes=max_constant_bytes)
+            n_state_leaves=n_state, donates_state=donates,
+            precision=precision, max_constant_bytes=max_constant_bytes,
+            compress_ratio=ratio, aux_bytes=aux_bytes)
 
     for strategy in strategies:
         mesh = single_mesh if strategy == "single" else full_mesh
         w = mesh.devices.size
         b = max(w, (global_batch // w) * w)
-        sds = _train_sds(mesh, state_sds, b, window)
         strat = get_strategy(strategy)
+        # Stateful tiers carry (world, ...)-stacked compression state in
+        # the optimizer — the abstract state must grow it too.
+        st_sds = jax.eval_shape(
+            lambda k: steplib.init_train_state(init_fn, k, strat, w),
+            jax.random.PRNGKey(0))
+        n_state = len(jax.tree_util.tree_leaves(st_sds))
+        ratio = _compress_ratio(strategy, strat)
+        sds = _train_sds(mesh, st_sds, b, window)
         for path in paths:
             name = f"train/{path}/{strategy}"
             if path == "step":
@@ -551,8 +649,8 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
             jaxpr = (jax.make_jaxpr(fn)(*args)
                      if path == "window" else None)
             result.reports.append(audit_program(
-                text, contract(name, strategy, w, donates), jaxpr,
-                waive=waive))
+                text, contract(name, strategy, w, donates, n_state, ratio),
+                jaxpr, waive=waive))
             if path == "window":
                 window_depths[strategy] = \
                     result.reports[-1].stats["chain_depth"]
@@ -566,7 +664,8 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
         args = (sds["state"], sds["epoch_images"], sds["epoch_labels"])
         text = _hlo_text(ev.lower(*args))
         result.reports.append(audit_program(
-            text, contract("eval/window", "eval", world, False),
+            text, contract("eval/window", "eval", world, False,
+                           len(jax.tree_util.tree_leaves(state_sds)), 1.0),
             jax.make_jaxpr(ev)(*args), waive=waive))
 
     if serve_buckets:

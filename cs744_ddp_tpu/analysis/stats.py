@@ -118,6 +118,62 @@ def collective_bytes(hlo: ModuleOrText) -> Dict[str, int]:
     return out
 
 
+def collective_dot_cones(hlo: ModuleOrText) -> Dict:
+    """Dots (matmuls/convolutions) in each collective's transitive operand
+    cone — the static overlap signature (audit rule for the overlapped
+    gradient-sync tier).
+
+    A collective whose cone contains EVERY dot in the program can only
+    start after all compute finishes — the single post-backward chain the
+    overlap tier exists to break.  A cone missing some dots is a
+    collective the latency-hiding scheduler may issue while the remaining
+    backward still runs.  Called computations fold in conservatively:
+    every dot inside a callee joins the caller instruction's cone.
+
+    Returns {"cones": {"comp/ins": n_dots_in_cone}, "total_dots": N,
+    "min_cone": smallest cone (0 when there are no collectives)}.
+    """
+    module = _as_module(hlo)
+    comp_dots: Dict[str, frozenset] = {}
+
+    def all_dots(cname, stack=()) -> frozenset:
+        """Every dot id inside computation ``cname``, callees included."""
+        if cname in comp_dots:
+            return comp_dots[cname]
+        if cname in stack or cname not in module.computations:
+            return frozenset()
+        acc = set()
+        for ins in module.computations[cname].instructions.values():
+            if ins.opcode in ("dot", "convolution"):
+                acc.add(f"{cname}/{ins.name}")
+            for c in ins.called:
+                acc |= all_dots(c, stack + (cname,))
+        comp_dots[cname] = frozenset(acc)
+        return comp_dots[cname]
+
+    cones: Dict[str, int] = {}
+    total: set = set()
+    for cname, comp in module.computations.items():
+        local: Dict[str, frozenset] = {}
+        for ins in comp.instructions.values():
+            cone = set()
+            for r in ins.operands:
+                cone |= local.get(r, frozenset())
+            for c in ins.called:
+                cone |= all_dots(c, (cname,))
+            if ins.opcode in ("dot", "convolution"):
+                cone.add(f"{cname}/{ins.name}")
+            local[ins.name] = frozenset(cone)
+            if collective_weight(ins.opcode):
+                cones[f"{cname}/{ins.name}"] = len(cone)
+        total |= all_dots(cname)
+    return {
+        "cones": cones,
+        "total_dots": len(total),
+        "min_cone": min(cones.values(), default=0),
+    }
+
+
 def collective_chain_depth(hlo: ModuleOrText) -> int:
     """Longest dependency chain of collectives in the module: the number
     of collectives that must execute SEQUENTIALLY (each consuming a value

@@ -5,8 +5,11 @@ Reference flags (``/root/reference/src/Part 2a/main.py:156-175``):
 ``--epochs`` (default 1); port 6585 and global batch 256 hardcoded.  Here the
 same knobs exist (with modern aliases), plus:
 
-  * ``--strategy {single,gather,allreduce,ddp}`` selects the Part-1/2a/2b/3
-    gradient-sync strategy;
+  * ``--strategy {single,gather,allreduce,ddp,overlap,compress-bf16,
+    compress-int8,powersgd}`` selects the gradient-sync strategy: the
+    Part-1/2a/2b/3 reference equivalents plus the round-7 extensions
+    (overlapped bucketed DDP and the compressed collectives —
+    error-feedback bf16/int8 quantization and PowerSGD low-rank);
   * ``--model {vgg11,resnet18}`` selects the model (resnet18 = the
     BASELINE.json stress config);
   * ``--num-devices`` restricts the mesh (e.g. to compare 1 vs 8 chips).
@@ -39,8 +42,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--epochs", type=int, default=1,
                    help="epochs to run (reference default 1)")
     p.add_argument("--strategy", default="allreduce",
-                   choices=["single", "gather", "allreduce", "ddp"],
-                   help="gradient sync strategy: Part 1/2a/2b/3 equivalents")
+                   choices=["single", "gather", "allreduce", "ddp",
+                            "overlap", "compress-bf16", "compress-int8",
+                            "powersgd"],
+                   help="gradient sync strategy: Part 1/2a/2b/3 equivalents "
+                        "(single/gather/allreduce/ddp) plus overlapped "
+                        "bucketed DDP (overlap) and the compressed "
+                        "collectives (compress-bf16/compress-int8 with "
+                        "error feedback, powersgd low-rank)")
+    p.add_argument("--compress-rank", type=int, default=None,
+                   help="PowerSGD approximation rank (default 4); only "
+                        "meaningful with --strategy powersgd")
     p.add_argument("--model", default="vgg11",
                    help="vgg11/13/16/19, resnet18/34, or any name "
                         "registered via models.register_model (validated "
@@ -202,7 +214,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "warn prints findings and continues; strict "
                          "exits 2 on any unwaived finding")
     au.add_argument("--audit-zoo", action="store_true",
-                    help="audit the FULL program zoo (all 4 strategies x "
+                    help="audit the FULL program zoo (all 8 strategies x "
                          "3 train paths, eval, the serving ladder at "
                          "--serve-buckets) and exit without training; "
                          "combine with --audit strict for the CI gate")
@@ -286,6 +298,7 @@ def elastic_main(args, telemetry) -> None:
     def make_trainer(w: int) -> Trainer:
         return Trainer(
             model=args.model, strategy=args.strategy, num_devices=w,
+            compress_rank=args.compress_rank,
             global_batch=args.batch_size, data_dir=args.data_dir,
             augment=not args.no_augment, precision=args.precision,
             sgd_cfg=sgd.SGDConfig(lr=args.lr, momentum=args.momentum,
@@ -388,6 +401,7 @@ def main(argv=None) -> None:
         model=args.model,
         strategy=args.strategy,
         num_devices=args.num_devices,
+        compress_rank=args.compress_rank,
         global_batch=args.batch_size,
         data_dir=args.data_dir,
         augment=not args.no_augment,

@@ -25,6 +25,14 @@ import jax.numpy as jnp
 
 class SGDState(NamedTuple):
     momentum: Any          # pytree like params; velocity buffers
+    # Gradient-sync communication state (None for stateless strategies):
+    # the compressed tiers' error-feedback residuals and PowerSGD Q
+    # factors (parallel/strategies.py), stacked per worker on a leading
+    # mesh axis.  It rides in the optimizer state so checkpoints carry it
+    # (bitwise preemption resume) and the windowed programs donate it; the
+    # SGD update itself never touches it — the strategy writes it via
+    # train/step.py's apply_strategy threading.
+    comm: Any = None
 
 
 class SGDConfig(NamedTuple):
@@ -45,4 +53,4 @@ def update(params: Any, grads: Any, state: SGDState,
     new_vel = jax.tree.map(lambda v, dd: cfg.momentum * v + dd,
                            state.momentum, d)
     new_params = jax.tree.map(lambda p, v: p - cfg.lr * v, params, new_vel)
-    return new_params, SGDState(momentum=new_vel)
+    return new_params, SGDState(momentum=new_vel, comm=state.comm)

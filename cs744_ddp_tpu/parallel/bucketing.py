@@ -34,6 +34,35 @@ class BucketPlan(NamedTuple):
         return len(self.buckets)
 
 
+class BucketSchedule(NamedTuple):
+    """Issue schedule for overlap-capable bucket reduction.
+
+    ``order`` lists bucket indices in READINESS order: the order in which
+    each bucket's last gradient is produced during backward.  Buckets are
+    built in reverse registration order (make_plan), so bucket 0 holds the
+    last-registered leaves — the first gradients backward produces — and
+    readiness order is plan order.  ``gate_leaf`` names, per bucket, the
+    member with the LOWEST registration index: its gradient is the last of
+    the bucket's to become ready, so it alone gates the bucket's collective.
+
+    The schedule is what makes the overlapped tier ppermute-friendly: each
+    bucket's all-reduce depends only on its own gate, never on another
+    bucket's collective, so a ring lowering (reduce-scatter/all-gather via
+    ``ppermute`` hops) can pipeline bucket k's first hop while bucket k+1's
+    gradients are still being produced — the latency-hiding scheduler sees
+    independent collective roots instead of one post-backward chain.
+    """
+    order: Tuple[int, ...]
+    gate_leaf: Tuple[int, ...]
+
+
+def make_schedule(plan: BucketPlan) -> BucketSchedule:
+    """Readiness-order issue schedule for ``plan`` (see BucketSchedule)."""
+    order = tuple(range(len(plan.buckets)))
+    gate = tuple(min(b) for b in plan.buckets)
+    return BucketSchedule(order=order, gate_leaf=gate)
+
+
 def make_plan(params_like: Any,
               bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> BucketPlan:
     leaves, treedef = jax.tree.flatten(params_like)

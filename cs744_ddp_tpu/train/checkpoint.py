@@ -31,11 +31,27 @@ from .step import TrainState
 # optimizer-state field added/removed): old checkpoints cannot be restored
 # across such changes, and without this stamp the failure is orbax's opaque
 # structure error (or a config-digest mismatch that doesn't say WHY).
-# History: 1 = SGDState carried a step counter; 2 = it doesn't.
-STATE_FORMAT_VERSION = 2
+# History: 1 = SGDState carried a step counter; 2 = it doesn't;
+# 3 = SGDState gained ``comm`` (gradient-compression error-feedback
+# residuals / PowerSGD factors, stacked per worker — parallel/strategies).
+STATE_FORMAT_VERSION = 3
 # The structure every pre-stamp directory holds (the 1 -> 2 change predates
 # the stamp's introduction) — what a missing stamp migrates to.
 _UNSTAMPED_DIR_VERSION = 2
+
+def _v2_structure_is_current(config: Optional[dict]) -> bool:
+    """Whether a version-2 checkpoint holds this build's structure anyway.
+
+    The 2 -> 3 bump added ``SGDState.comm`` — which is ``None`` (an empty
+    pytree) for every stateless strategy, so a v2 save from such a run is
+    leaf-for-leaf the structure this build stores and restores.  Refusing
+    it would strand every pre-compression checkpoint for no reason; only
+    the stateful tiers (compress-*/powersgd), which post-date version 2,
+    genuinely need the new structure."""
+    from ..parallel.strategies import STRATEGIES
+    strat = STRATEGIES.get(str((config or {}).get("strategy", "")).lower())
+    return strat is not None and not getattr(strat, "stateful", False)
+
 
 # Mid-epoch (emergency) checkpoints are keyed by one orbax step integer
 # encoding (epoch, step-within-epoch); an epoch never holds this many
@@ -125,11 +141,19 @@ class CheckpointManager:
                 # Dirs written before the stamp existed: the step-counter
                 # removal (version 1 -> 2) predates the stamp's introduction
                 # by three rounds, so every unstamped dir on disk is KNOWN to
-                # hold the version-2 structure — accept it as exactly that
-                # (NOT as the current version, or a future bump to 3 would
-                # silently re-accept pre-stamp v2 dirs).
+                # hold the version-2 structure — read it as exactly that
+                # (NOT blindly as the current version) and let the
+                # structural migration below decide.
                 saved_ver = _UNSTAMPED_DIR_VERSION
                 existing["state_format_version"] = _UNSTAMPED_DIR_VERSION
+            if saved_ver == _UNSTAMPED_DIR_VERSION != STATE_FORMAT_VERSION \
+                    and _v2_structure_is_current(config):
+                # One-time 2 -> 3 migration: the bump only changed the
+                # stored structure for stateful (compressed) strategies,
+                # so a stateless run's v2 dir is accepted — and re-stamped
+                # as current — rather than stranded (ADVICE r4).
+                saved_ver = STATE_FORMAT_VERSION
+                existing["state_format_version"] = STATE_FORMAT_VERSION
             if saved_ver != STATE_FORMAT_VERSION:
                 raise ValueError(
                     f"checkpoint dir {directory} holds state-format version "

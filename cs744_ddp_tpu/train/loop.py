@@ -41,7 +41,7 @@ from ..ft import guard as ftguard
 from ..ft import supervisor as ftsup
 from ..obs import NULL, git_sha
 from ..ops import sgd
-from ..parallel import get_strategy, mesh as meshlib
+from ..parallel import get_strategy, mesh as meshlib, strategies
 from ..utils.metrics import WINDOW, WindowedTimers
 from . import step as steplib
 
@@ -111,6 +111,7 @@ class Trainer:
 
     def __init__(self, model: str = "vgg11", strategy: str = "allreduce",
                  *, mesh=None, num_devices: Optional[int] = None,
+                 compress_rank: Optional[int] = None,
                  global_batch: int = GLOBAL_BATCH, data_dir: str = "./data",
                  seed: int = SEED, augment: bool = True,
                  sgd_cfg: sgd.SGDConfig = sgd.SGDConfig(),
@@ -286,17 +287,22 @@ class Trainer:
         else:
             self.model_name = "custom"
             init_fn, self.apply_fn = model
-        self.state = steplib.init_train_state(
-            init_fn, jax.random.PRNGKey(seed))
-        # Commit the state to the mesh (replicated) up front: otherwise the
-        # first windowed call sees uncommitted arrays and the second call a
-        # different sharding signature -> a full recompile.  put_global_tree
-        # keeps this correct when the mesh spans multiple processes.
-        self.state = meshlib.put_global_tree(
-            self.state, meshlib.replicated(self.mesh))
         self.strategy_name = strategy
         self.sgd_cfg = sgd_cfg
-        strat = get_strategy(strategy)
+        # compress_rank only parameterizes the powersgd tier; None defers
+        # to the strategy default (strategies.DEFAULT_COMPRESS_RANK).
+        self.compress_rank = compress_rank
+        strat = self._strategy = get_strategy(
+            strategy, **({} if compress_rank is None
+                         else {"compress_rank": compress_rank}))
+        self.state = steplib.init_train_state(
+            init_fn, jax.random.PRNGKey(seed), strat, self.world)
+        # Commit the state to the mesh up front: otherwise the first
+        # windowed call sees uncommitted arrays and the second call a
+        # different sharding signature -> a full recompile.  Everything is
+        # replicated except a stateful strategy's comm state, which lives
+        # sharded over the data axis (_commit_state).
+        self.state = self._commit_state(self.state)
         self.train_step = steplib.make_train_step(
             self.apply_fn, strat, self.mesh, sgd_cfg, augment=augment,
             compute_dtype=compute_dtype, nonfinite_guard=self._guard_on)
@@ -415,6 +421,26 @@ class Trainer:
                 "git_sha": git_sha(),
             })
 
+    def _commit_state(self, state) -> "steplib.TrainState":
+        """Commit a (host or device) TrainState to the mesh: params/BN/
+        momentum replicated, a stateful strategy's comm state sharded over
+        the data axis — its leaves are (world, ...) per-worker stacks and
+        each mesh position owns exactly its own slice (strategies
+        ``_stack_zeros_like``; the compiled programs consume it under
+        ``P(DATA_AXIS)``, steplib._opt_specs).  Committing both shardings
+        up front keeps every later dispatch signature-stable."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        comm = state.opt_state.comm
+        stripped = state._replace(
+            opt_state=state.opt_state._replace(comm=None))
+        out = meshlib.put_global_tree(stripped, meshlib.replicated(self.mesh))
+        if comm is not None:
+            sharded = NamedSharding(self.mesh, P(meshlib.DATA_AXIS))
+            comm = jax.tree.map(
+                lambda a: meshlib.put_global(
+                    np.asarray(jax.device_get(a)), sharded), comm)
+        return out._replace(opt_state=out.opt_state._replace(comm=comm))
+
     # -- telemetry helpers ---------------------------------------------------
 
     def _emit_device_gauges(self, epoch: int) -> None:
@@ -471,6 +497,20 @@ class Trainer:
                 "total_count": stats["total_count"],
                 "total_result_mib": stats["total_result_mib"],
                 "chain_depth": hlo_stats.collective_chain_depth(txt)})
+        # Compression headline: the uncompressed wire cost is every f32
+        # gradient byte exactly once (per_param_psum's result bytes); the
+        # delta against this strategy's measured collective bytes is what
+        # a compressed tier buys.  gather's doubled comm clamps to 0 saved.
+        grad_mib = float(sum(
+            int(np.prod(a.shape, dtype=np.int64)) * 4
+            for a in jax.tree.leaves(self.state.params))) / 2 ** 20
+        self.telemetry.gauge(
+            "comm_bytes_saved", {
+                "strategy": self.strategy_name,
+                "baseline_grad_mib": round(grad_mib, 3),
+                "strategy_result_mib": stats["total_result_mib"],
+                "saved_mib": round(
+                    max(0.0, grad_mib - stats["total_result_mib"]), 3)})
 
     # -- fault tolerance (ft/) ----------------------------------------------
 
@@ -483,9 +523,9 @@ class Trainer:
             lambda a: np.asarray(jax.device_get(a)), self.state)
 
     def _restore_rollback(self) -> None:
-        self.state = meshlib.put_global_tree(
-            jax.tree.map(jnp.asarray, self._rollback),
-            meshlib.replicated(self.mesh))
+        # _commit_state restores the dual sharding layout (replicated state,
+        # data-sharded comm) from the host snapshot.
+        self.state = self._commit_state(self._rollback)
 
     def _handle_nonfinite(self, oks, epoch: int) -> bool:
         """Host-side reaction to the fetched per-step ``ok`` flags.  The
@@ -537,7 +577,7 @@ class Trainer:
         fn = self._chaos_step_cache.get(cache_key)
         if fn is None:
             fn = steplib.make_train_step(
-                self.apply_fn, get_strategy(self.strategy_name), self.mesh,
+                self.apply_fn, self._strategy, self.mesh,
                 self.sgd_cfg, augment="host" if host else self.augment,
                 compute_dtype=self.compute_dtype, nonfinite_guard=True,
                 inject_nonfinite=True)
@@ -844,14 +884,20 @@ class Trainer:
             # not depend on scan-length-invariance of the compiler.
             w = min(WINDOW - start % WINDOW, nbatches - start)
             t0 = time.time()
-            out = self.train_window(
-                self.state, key, epoch_images, epoch_labels,
-                jnp.int32(start), jnp.zeros((w,), jnp.int8))
-            if self._guard_on:
-                self.state, losses, oks = out
-            else:
-                (self.state, losses), oks = out, None
-            losses = np.asarray(losses)  # value fetch = completion fence
+            # The span is tagged with the gradient-sync strategy so the
+            # telemetry timeline attributes window wall time per tier
+            # (the compressed-collective bench reads these back).
+            with self.telemetry.span("train_window",
+                                     strategy=self.strategy_name,
+                                     start=int(start), batches=int(w)):
+                out = self.train_window(
+                    self.state, key, epoch_images, epoch_labels,
+                    jnp.int32(start), jnp.zeros((w,), jnp.int8))
+                if self._guard_on:
+                    self.state, losses, oks = out
+                else:
+                    (self.state, losses), oks = out, None
+                losses = np.asarray(losses)  # value fetch = completion fence
             per_iter = (time.time() - t0) / w
             for loss in losses:
                 timers.record(float(loss), per_iter)
@@ -1748,6 +1794,41 @@ class Trainer:
                    if plan.examples_replayed else ""))
         return plan.start_step
 
+    def _ckpt_state_like(self, meta: Optional[dict]):
+        """(state_like, saved_world) for a checkpoint restore.  When the
+        save's world differs from this trainer's (elastic resume), the comm
+        stack on disk is (saved_world, ...) — build the abstract tree at
+        that shape, replicated (the new mesh need not divide the old
+        world); ``_absorb_restored`` reshards after the restore.  Params/
+        BN/momentum are world-invariant and restore directly."""
+        comm = self.state.opt_state.comm
+        if comm is None:
+            return self.state, self.world
+        from ..elastic.protocol import flat_meta
+        flat = flat_meta(meta)
+        saved = int(flat.get("world") or self.world)
+        if saved == self.world:
+            return self.state, saved
+        rep = meshlib.replicated(self.mesh)
+        resized = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(
+                (saved,) + tuple(a.shape[1:]), a.dtype, sharding=rep),
+            comm)
+        return self.state._replace(
+            opt_state=self.state.opt_state._replace(comm=resized)), saved
+
+    def _absorb_restored(self, state, saved_world: int):
+        """Finish a restore: on a world mismatch, map the restored
+        (saved_world, ...) comm stack onto this world — sum-conserving for
+        error-feedback residuals (strategies.reshard_comm) — and re-commit
+        the dual sharding layout (_commit_state)."""
+        if state.opt_state.comm is None or saved_world == self.world:
+            return state
+        comm = strategies.reshard_comm(
+            jax.device_get(state.opt_state.comm), self.world)
+        return self._commit_state(
+            state._replace(opt_state=state.opt_state._replace(comm=comm)))
+
     def run(self, epochs: int = 1,
             checkpoint_dir: Optional[str] = None,
             profile_dir: Optional[str] = None) -> None:
@@ -1781,10 +1862,16 @@ class Trainer:
             # so two "custom" models or any architecture drift fail the
             # guard; real_data catches the silent synthetic-fallback case
             # (same config keys, different dataset).
+            # comm is EXCLUDED from the digest: its leaves are (world, ...)
+            # stacks, and an elastic resume legitimately changes world —
+            # the "strategy"/"compress_rank" keys pin its identity instead.
+            digest_state = self.state._replace(
+                opt_state=self.state.opt_state._replace(comm=None))
             param_tree = jax.tree.map(
-                lambda a: f"{a.dtype}{list(a.shape)}", self.state)
+                lambda a: f"{a.dtype}{list(a.shape)}", digest_state)
             mngr = CheckpointManager(checkpoint_dir, config={
                 "model": self.model_name, "strategy": self.strategy_name,
+                "compress_rank": self.compress_rank,
                 "seed": self.seed, "precision": self.precision,
                 "global_batch": self.global_batch, "world": self.world,
                 "augment": self.augment,
@@ -1803,15 +1890,20 @@ class Trainer:
             mid = mngr.latest_mid_epoch()
             le = mngr.latest_epoch()
             if mid is not None and (le is None or mid[0] > le):
-                self.state, start_epoch, start_step = \
-                    mngr.restore_mid_epoch(self.state)
+                like, saved_world = self._ckpt_state_like(
+                    mngr.mid_epoch_meta())
+                restored, start_epoch, start_step = \
+                    mngr.restore_mid_epoch(like)
+                self.state = self._absorb_restored(restored, saved_world)
                 if self.elastic is not None:
                     start_step = self._plan_elastic_resume(
                         mngr.mid_epoch_meta(), start_step)
                 self.log(f"Resumed from mid-epoch checkpoint: epoch "
                          f"{start_epoch}, step {start_step}")
             elif le is not None:
-                self.state, start_epoch = mngr.restore(self.state)
+                like, saved_world = self._ckpt_state_like(mngr.epoch_meta())
+                restored, start_epoch = mngr.restore(like)
+                self.state = self._absorb_restored(restored, saved_world)
                 self.log(f"Resumed from checkpoint: epoch {start_epoch}")
             if self._nf_policy == "restore" and \
                     (mid is not None or le is not None):

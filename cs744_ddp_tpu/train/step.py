@@ -100,22 +100,61 @@ class TrainState(NamedTuple):
     opt_state: sgd.SGDState
 
 
-def init_train_state(init_fn, key: jax.Array) -> TrainState:
+def init_train_state(init_fn, key: jax.Array, strategy=None,
+                     world: int = 1) -> TrainState:
     """Seed-identical init on every process — the reference relies on
     identical seeds instead of a parameter broadcast (SURVEY.md C12); in SPMD
     the replicated init is constructed once and placed on all devices, making
-    that invariant structural rather than probabilistic."""
+    that invariant structural rather than probabilistic.
+
+    A STATEFUL ``strategy`` (the compressed gradient-sync tiers,
+    parallel/strategies.py) contributes its communication state — error
+    feedback residuals, PowerSGD Q factors — to ``SGDState.comm``, stacked
+    per worker for a ``world``-position mesh; stateless strategies leave
+    ``comm`` None and the pytree identical to the pre-compression layout."""
     params, bn_state = init_fn(key)
-    return TrainState(params=params, bn_state=bn_state,
-                      opt_state=sgd.init(params))
+    opt = sgd.init(params)
+    if strategy is not None and getattr(strategy, "stateful", False):
+        opt = opt._replace(comm=strategy.init_comm(params, world))
+    return TrainState(params=params, bn_state=bn_state, opt_state=opt)
 
 
-def _guarded_update(params, bn_state, opt_state, grads, cfg, loss, new_bn):
+def apply_strategy(strategy, grads, axis_name, comm):
+    """Run the gradient-sync strategy, threading communication state.
+
+    Stateful strategies are ``(grads, axis, comm) -> (grads, comm')``;
+    stateless ones are ``(grads, axis) -> grads`` and pass ``comm``
+    through untouched.  The ONE dispatch point, so every execution path
+    (fused step, train window, host window) threads identically."""
+    if getattr(strategy, "stateful", False):
+        return strategy(grads, axis_name, comm)
+    return strategy(grads, axis_name), comm
+
+
+def _opt_specs(strategy):
+    """shard_map partition specs for the optimizer state: everything
+    replicated except a stateful strategy's comm state, which is per-worker
+    — stacked on a leading mesh axis and sharded over DATA_AXIS so each
+    position carries only its own residual/factor slice (the global array
+    a checkpoint sees is the (world, ...) stack)."""
+    if not getattr(strategy, "stateful", False):
+        return P()
+    return sgd.SGDState(momentum=P(), comm=P(DATA_AXIS))
+
+
+def _guarded_update(params, bn_state, opt_state, grads, cfg, loss, new_bn,
+                    staged_opt=None):
     """The non-finite-guarded tail of a train step: one finiteness scalar
     decides, branch-free, between the SGD update and keeping the ENTIRE
-    prior state (params, BN stats, momentum) — see ft/guard.py."""
+    prior state (params, BN stats, momentum) — see ft/guard.py.
+
+    ``staged_opt`` (compressed strategies) is the optimizer state with the
+    strategy's freshly-written comm state: the update branch applies it,
+    while the keep branch restores ``opt_state`` — the PRE-sync comm —
+    so a non-finite step leaves no poisoned residuals behind."""
     ok = ftguard.finite_ok(loss, grads)
-    upd_params, upd_opt = sgd.update(params, grads, opt_state, cfg)
+    upd_params, upd_opt = sgd.update(
+        params, grads, opt_state if staged_opt is None else staged_opt, cfg)
     return (ftguard.select_update(ok, upd_params, params),
             ftguard.select_update(ok, new_bn, bn_state),
             ftguard.select_update(ok, upd_opt, opt_state), ok)
@@ -198,20 +237,24 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
             # a shard and spreads through the collective, and so must the
             # injected one.
             grads = ftguard.inject_nan(grads)
-        grads = strategy(grads, DATA_AXIS)
+        grads, new_comm = apply_strategy(strategy, grads, DATA_AXIS,
+                                         opt_state.comm)
+        staged_opt = opt_state._replace(comm=new_comm)
         new_bn = jax.tree.map(lambda a: lax.pmean(a, DATA_AXIS), new_bn)
         loss = lax.pmean(loss, DATA_AXIS)
         if nonfinite_guard:
             return _guarded_update(params, bn_state, opt_state, grads, cfg,
-                                   loss, new_bn) + (loss,)
-        new_params, new_opt = sgd.update(params, grads, opt_state, cfg)
+                                   loss, new_bn,
+                                   staged_opt=staged_opt) + (loss,)
+        new_params, new_opt = sgd.update(params, grads, staged_opt, cfg)
         return new_params, new_bn, new_opt, loss
 
-    out_specs = ((P(), P(), P(), P(), P()) if nonfinite_guard
-                 else (P(), P(), P(), P()))
+    opt_spec = _opt_specs(strategy)
+    out_specs = ((P(), P(), opt_spec, P(), P()) if nonfinite_guard
+                 else (P(), P(), opt_spec, P()))
     mapped = shard_map(
         shard_body, mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(P(), P(), opt_spec, P(), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=out_specs,
         **_SHARD_MAP_KW,
     )
@@ -294,16 +337,18 @@ def make_train_window(apply_fn: Callable,
                 for s in chaos_steps[1:]:
                     mask = mask | (idx == s)
                 grads = ftguard.inject_nan(grads, mask=mask)
-            grads = strategy_fn(grads)
+            grads, new_comm = strategy_fn(grads, opt_state.comm)
+            staged_opt = opt_state._replace(comm=new_comm)
             if axis_ok:
                 new_bn = jax.tree.map(
                     lambda a: lax.pmean(a, DATA_AXIS), new_bn)
                 loss = lax.pmean(loss, DATA_AXIS)
             if nonfinite_guard:
                 p, bn, opt, ok = _guarded_update(
-                    params, bn_state, opt_state, grads, cfg, loss, new_bn)
+                    params, bn_state, opt_state, grads, cfg, loss, new_bn,
+                    staged_opt=staged_opt)
                 return (p, bn, opt, key), (loss, ok)
-            new_params, new_opt = sgd.update(params, grads, opt_state, cfg)
+            new_params, new_opt = sgd.update(params, grads, staged_opt, cfg)
             return (new_params, new_bn, new_opt, key), loss
         return one
 
@@ -316,8 +361,9 @@ def make_train_window(apply_fn: Callable,
         labs = lax.dynamic_slice_in_dim(epoch_labels, start, w, axis=0)
         idxs = start + jnp.arange(w, dtype=jnp.int32)
         one = scan_one(apply_fn,
-                       (lambda g: g) if single
-                       else (lambda g: strategy(g, DATA_AXIS)),
+                       (lambda g, c: (g, c)) if single
+                       else (lambda g, c: apply_strategy(
+                           strategy, g, DATA_AXIS, c)),
                        axis_ok=not single)
         (p, bn, opt, _), ys = lax.scan(
             one, (params, bn_state, opt_state, key), (imgs, labs, idxs))
@@ -340,12 +386,13 @@ def make_train_window(apply_fn: Callable,
 
         return window
 
-    out_specs = ((P(), P(), P(), P(), P()) if nonfinite_guard
-                 else (P(), P(), P(), P()))
+    opt_spec = _opt_specs(strategy)
+    out_specs = ((P(), P(), opt_spec, P(), P()) if nonfinite_guard
+                 else (P(), P(), opt_spec, P()))
     mapped = shard_map(
         window_body, mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(None, DATA_AXIS), P(None, DATA_AXIS),
-                  P(), P()),
+        in_specs=(P(), P(), opt_spec, P(), P(None, DATA_AXIS),
+                  P(None, DATA_AXIS), P(), P()),
         out_specs=out_specs,
         **_SHARD_MAP_KW,
     )

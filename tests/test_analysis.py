@@ -214,6 +214,108 @@ def test_rule_collective_contract_conforming():
     assert auditlib.audit_program(clean, _contract(strategy="single")).passed
 
 
+_WIRE = """\
+HloModule wire
+
+radd {
+  x = DT[] parameter(0)
+  y = DT[] parameter(1)
+  ROOT s = DT[] add(x, y)
+}
+
+ENTRY main {
+  p = DT[64] parameter(0)
+  q = DT[64] parameter(1)
+  a1 = DT[64] all-reduce(p), channel_id=1, to_apply=radd
+  a2 = DT[64] all-reduce(q), channel_id=2, to_apply=radd
+  ROOT o = DT[64] add(a1, a2)
+}
+"""
+
+
+def test_rule_overlap_contract_seeded():
+    # A 3-deep post-backward chain is exactly what the overlap tier must
+    # NOT lower — same fused count as ddp, but fully serialized.
+    r = auditlib.audit_program(_CHAIN3, _contract(
+        strategy="overlap", world=4, nleaves=3, nbuckets=3))
+    assert _rules_of(r) == {"collective-contract"}
+    assert "must not chain" in r.findings[0].message
+    # Two INDEPENDENT all-reduces (chain depth 1) conform.
+    r = auditlib.audit_program(_WIRE.replace("DT", "f32"), _contract(
+        strategy="overlap", world=4, nleaves=2, nbuckets=2))
+    assert r.passed, r.findings
+    # Fewer reduces than buckets: a bucket went unsynced.
+    assert not auditlib.audit_program(
+        _WIRE.replace("DT", "f32"), _contract(
+            strategy="overlap", world=4, nleaves=3, nbuckets=3)).passed
+
+
+_GATED = """\
+HloModule gated
+
+radd {
+  x = f32[] parameter(0)
+  y = f32[] parameter(1)
+  ROOT s = f32[] add(x, y)
+}
+
+ENTRY main {
+  a = f32[8,8] parameter(0)
+  b = f32[8,8] parameter(1)
+  d1 = f32[8,8] dot(a, b), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  d2 = f32[8,8] dot(b, a), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  SRC
+  ar = f32[8,8] all-reduce(red), channel_id=1, to_apply=radd
+  ROOT o = f32[8,8] add(ar, SINK)
+}
+"""
+
+
+def test_rule_overlap_dot_cone_seeded():
+    """The overlap tier's scheduling evidence: at least one collective's
+    operand cone must exclude part of the backward — a collective gated
+    on EVERY dot cannot have been issued early."""
+    allgated = (_GATED.replace("SRC", "red = f32[8,8] add(d1, d2)")
+                .replace("SINK", "ar"))
+    r = auditlib.audit_program(allgated, _contract(
+        strategy="overlap", world=4, nleaves=1, nbuckets=1))
+    assert _rules_of(r) == {"collective-contract"}
+    assert "operand cone" in r.findings[0].message
+    # The same program with the reduce gated on d1 only: d2 is outside
+    # the cone, so the collective COULD overlap it — conforming.
+    partial = (_GATED.replace("SRC", "red = f32[8,8] add(d1, d1)")
+               .replace("SINK", "d2"))
+    assert auditlib.audit_program(partial, _contract(
+        strategy="overlap", world=4, nleaves=1, nbuckets=1)).passed
+
+
+def test_rule_compressed_bytes_seeded():
+    c2 = dict(strategy="compress-bf16", world=4, nleaves=2,
+              param_bytes=512, compress_ratio=2.0)
+    # An uncompressed f32 wire (512 B) against the 2x contract: caught.
+    r = auditlib.audit_program(_WIRE.replace("DT", "f32"), _contract(**c2))
+    assert _rules_of(r) == {"collective-contract"}
+    assert "compression is not real" in r.findings[0].message
+    # The genuine bf16 wire (256 B = param_bytes/2): conforming.
+    assert auditlib.audit_program(_WIRE.replace("DT", "bf16"),
+                                  _contract(**c2)).passed, "bf16 wire"
+    # int8 contract (4x): bf16 wire fails, s8 wire (128 B) passes.
+    c4 = dict(c2, strategy="compress-int8", compress_ratio=4.0)
+    assert not auditlib.audit_program(_WIRE.replace("DT", "bf16"),
+                                      _contract(**c4)).passed
+    assert auditlib.audit_program(_WIRE.replace("DT", "s8"),
+                                  _contract(**c4)).passed
+    # Declared aux allowance (BN pmeans, int8 scale pmax) is excluded
+    # from the gradient wire before the ratio is enforced.
+    assert auditlib.audit_program(
+        _WIRE.replace("DT", "f32"),
+        _contract(**dict(c2, aux_bytes=256))).passed
+    # Every leaf must still be reduced.
+    assert not auditlib.audit_program(
+        _WIRE.replace("DT", "bf16"),
+        _contract(**dict(c2, nleaves=3))).passed
+
+
 _LEAK = """\
 HloModule leak
 
@@ -393,11 +495,14 @@ def zoo():
 
 def test_zoo_audits_clean(zoo):
     assert zoo.clean, "\n".join(zoo.format_lines())
-    # 4 strategies x 3 train paths + eval + 1 serving bucket.
-    assert len(zoo.reports) == 14
+    # 8 strategies x 3 train paths + eval + 1 serving bucket.
+    assert len(zoo.reports) == 26
     names = {r.program for r in zoo.reports}
     assert "train/window/ddp" in names and "eval/window" in names
     assert "serve/b2/f32" in names
+    assert "train/window/overlap" in names
+    assert "train/window/compress-int8" in names
+    assert "train/window/powersgd" in names
 
 
 def test_zoo_depth_ladder(zoo):
@@ -411,6 +516,14 @@ def test_zoo_depth_ladder(zoo):
     # tiers' defining shape (2/leaf, 1/leaf, 1/bucket).
     assert lad["gather"] == 2 * lad["allreduce"]
     assert lad["ddp"] == 1
+    # Round-7 tiers, recorded informatively alongside the certified trio:
+    # overlap never chains (depth 1 regardless of bucket count); the
+    # compressed tiers chain per leaf like allreduce (+1 for int8's
+    # shared-scale pmax); powersgd's two-psum leaves sit deepest.
+    assert lad["overlap"] == 1
+    assert lad["compress-bf16"] == lad["allreduce"]
+    assert lad["compress-int8"] == lad["allreduce"] + 1
+    assert lad["powersgd"] >= lad["allreduce"]
 
 
 def test_zoo_summary_shape(zoo):
@@ -559,6 +672,16 @@ def test_lint_unfenced_timing():
     # Timing with no dispatch inside is plain host timing: out of scope.
     host_only = _SRC_UNFENCED.replace("self.train_window(x)", "len(x)")
     assert pylint_rules.lint_source(host_only, "ok.py") == []
+    # Round-7 overlap scheduling: timing a PER-BUCKET dispatch loop is the
+    # same hazard — the loop queues every bucket's collective and the
+    # timer stops before any of them ran.  The rule must see through the
+    # loop nesting (bench.run_compression and the overlap tier's bucket
+    # walk are in the default lint targets).
+    bucketed = _SRC_UNFENCED.replace(
+        "loss = self.train_window(x)",
+        "for b in x:\n            loss = self.train_step(b)")
+    bad = pylint_rules.lint_source(bucketed, "bad.py")
+    assert [f.rule for f in bad] == ["unfenced-timing"]
 
 
 _SRC_THREAD_JNP = """\

@@ -134,6 +134,30 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     assert sv["latency"]["50rps"]["completed"] > 0
     assert "serve_dispatch" in sv["telemetry_summary"]["spans"]
 
+    # Compression section (round 7): per-tier measured wall-clock, static
+    # comm bytes from the audited lowering, and convergence delta vs the
+    # uncompressed allreduce baseline.
+    comp = result["compression"]
+    assert comp["world"] == 8 and comp["baseline_tier"] == "allreduce"
+    assert set(comp["per_tier"]) == set(bench.COMPRESSION_TIERS)
+    for e in comp["per_tier"].values():
+        assert e["wall_clock_s_best"] > 0
+        assert e["images_per_sec_per_chip"] > 0
+        assert e["comm_result_mib"] > 0
+        assert 0.0 <= e["test_accuracy_pct"] <= 100.0
+        assert -100.0 <= e["convergence_delta_pct"] <= 100.0
+    ratio = {t: comp["per_tier"][t]["comm_ratio_vs_allreduce"]
+             for t in comp["per_tier"]}
+    # The contract floors, measured on the lowering (aux collectives — BN
+    # pmeans, loss psum, int8's scale pmax — keep these just under the
+    # pure-gradient 2x/4x; powersgd's analytic ratio on tiny is ~2.4x,
+    # >=8x only on VGG-11-shaped leaves).
+    assert ratio["allreduce"] == 1.0
+    assert ratio["ddp"] >= 0.99 and ratio["overlap"] >= 0.99
+    assert ratio["compress-bf16"] > 1.9
+    assert ratio["compress-int8"] > 3.5
+    assert ratio["powersgd"] > 1.9
+
     # Scaling sweep: 1,2,4,8 devices; WEAK scaling (constant per-chip
     # batch); efficiency is per-chip relative to the 1-device run and must
     # be finite/positive; 1-device eff == 1.
@@ -397,11 +421,11 @@ def test_step_flops_per_image_is_world_invariant(tmp_path, mesh1, mesh8):
 #
 # The driver captures bench.py's final stdout line as "parsed"; rounds 4/5
 # shipped oversized heads the driver recorded as parsed:null (the failure
-# emit_result now prevents).  This guard makes the regression structural:
-# any newly committed round artifact must carry a parsed head with a
-# non-null headline.
-
-_GRANDFATHERED_NULL_HEADS = {"BENCH_r04.json", "BENCH_r05.json"}
+# emit_result now prevents).  Round 7 backfilled those two heads from the
+# artifacts' own truncated tails + the round commits' BASELINE/VERDICT
+# prose (the backfill is labeled in a "reconstructed" field), so the guard
+# now holds unconditionally: EVERY committed round artifact must carry a
+# parsed head with a non-null headline.
 
 
 def test_committed_bench_artifacts_parse_with_headline():
@@ -415,14 +439,15 @@ def test_committed_bench_artifacts_parse_with_headline():
             art = json.load(f)                     # every artifact is JSON
         assert art["rc"] == 0, f"{name}: bench run failed"
         parsed = art.get("parsed")
-        if name in _GRANDFATHERED_NULL_HEADS:
-            assert parsed is None, (
-                f"{name}: grandfathered as parsed:null — if regenerated "
-                f"with a parsing head, remove it from the grandfather set")
-            continue
         assert isinstance(parsed, dict), f"{name}: head did not parse"
         assert parsed.get("value"), f"{name}: null/zero headline value"
         assert parsed.get("metric"), f"{name}: missing headline metric"
+    # The round-4/5 backfills carry their provenance.
+    for name in ("BENCH_r04.json", "BENCH_r05.json"):
+        with open(os.path.join(repo, name)) as f:
+            head = json.load(f)["parsed"]
+        assert "backfilled" in head["reconstructed"]
+        assert head["headline_stats"]["best"] == head["value"]
 
 
 def test_bench_full_sidecar_carries_elastic_section_slot():

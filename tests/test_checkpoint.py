@@ -115,9 +115,11 @@ def test_checkpoint_dir_rejects_different_hyperparameters(tmp_path, mesh4):
 def test_unstamped_checkpoint_dir_accepted_as_current_version(tmp_path,
                                                               mesh4):
     """Dirs written before the state_format_version stamp existed hold the
-    version-2 structure (the 1->2 change predates the stamp), so a missing
-    stamp must be accepted as the current version — a one-time migration —
-    rather than refusing resume (ADVICE r4)."""
+    version-2 structure (the 1->2 change predates the stamp).  For a
+    stateless strategy that IS the current structure (the 2->3 bump only
+    added ``SGDState.comm``, an empty pytree when stateless), so a missing
+    stamp must be accepted — a one-time migration — rather than refusing
+    resume (ADVICE r4)."""
     import json
     import os
     ckpt = str(tmp_path / "ckpt")
@@ -145,9 +147,45 @@ def test_unstamped_checkpoint_dir_accepted_as_current_version(tmp_path,
         for a, b in zip(jax.tree.leaves(state_after_1),
                         jax.tree.leaves(jax.tree.map(np.asarray, tr2.state))))
     assert d > 0.0  # trained past the restored epoch
-    # The one-time migration stamped the dir.
+    # The one-time migration stamped the dir as the CURRENT version (the
+    # stateless v2 structure is leaf-for-leaf the v3 structure).
+    from cs744_ddp_tpu.train.checkpoint import STATE_FORMAT_VERSION
     with open(cfg_path) as f:
-        assert json.load(f)["state_format_version"] == 2
+        assert json.load(f)["state_format_version"] == STATE_FORMAT_VERSION
+
+
+def test_unstamped_dir_rejected_for_stateful_strategy(tmp_path, mesh4):
+    """The 2->3 migration is CONDITIONAL: a stateful (compressed) strategy
+    stores error-feedback state in ``SGDState.comm``, so its structure is
+    genuinely version 3 — an unstamped (v2-structured) dir must still be
+    refused rather than deep-failing inside orbax on a structure
+    mismatch."""
+    import json
+    import os
+    import pytest
+    ckpt = str(tmp_path / "ckpt")
+    tr = Trainer(model=tiny_cnn(), strategy="compress-bf16", mesh=mesh4,
+                 global_batch=64, data_dir=str(tmp_path), augment=True,
+                 limit_eval_batches=1, log=lambda s: None)
+    shrink(tr)
+    tr.run(1, checkpoint_dir=ckpt)
+
+    cfg_path = os.path.join(ckpt, "trainer_config.json")
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    del cfg["state_format_version"]
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+
+    tr2 = Trainer(model=tiny_cnn(), strategy="compress-bf16", mesh=mesh4,
+                  global_batch=64, data_dir=str(tmp_path), augment=True,
+                  limit_eval_batches=1, log=lambda s: None)
+    shrink(tr2)
+    with pytest.raises(ValueError, match="state-format version"):
+        tr2.run(2, checkpoint_dir=ckpt)
+    # A rejected resume never modifies the dir's metadata.
+    with open(cfg_path) as f:
+        assert "state_format_version" not in json.load(f)
 
 
 # -- round 6: elastic metadata forward/backward compatibility ----------------

@@ -260,10 +260,10 @@ def test_trainer_validates_elastic_config(tmp_path, mesh4):
 
 def _elastic_trainer(tmp_path, world, **kw):
     kw.setdefault("limit_train_batches", 6)
-    return Trainer(model=tiny_cnn(), strategy="allreduce",
-                   mesh=make_mesh(world), global_batch=64,
-                   data_dir=str(tmp_path), seed=3, augment=True,
-                   limit_eval_batches=1, log=lambda s: None,
+    kw.setdefault("strategy", "allreduce")
+    return Trainer(model=tiny_cnn(), mesh=make_mesh(world),
+                   global_batch=64, data_dir=str(tmp_path), seed=3,
+                   augment=True, limit_eval_batches=1, log=lambda s: None,
                    elastic="strong", **kw)
 
 
@@ -309,3 +309,52 @@ def test_strong_scaling_trajectory_bitwise_identical_1_2_4(tmp_path,
         np.testing.assert_array_equal(x, y, err_msg="world 1 vs 2")
     for x, y in zip(la, lc):
         np.testing.assert_array_equal(x, y, err_msg="world 1 vs 4")
+
+
+def test_elastic_shrink_2_to_1_reshards_compressed_residuals(tmp_path,
+                                                             small_window):
+    """Round-7: EF residual state survives an elastic 2 -> 1 shrink.  The
+    on-disk comm stack is (2, ...); the resumed world-1 trainer absorbs it
+    sum-conserving (strategies.reshard_comm), so no quantization error
+    recorded before the shrink is lost — bitwise: the absorbed stack IS
+    the old stack's axis-0 sum."""
+    ck = str(tmp_path / "ck_shrink")
+    tr2 = _elastic_trainer(tmp_path, 2, strategy="compress-bf16",
+                           limit_train_batches=3)
+    # The strong-elastic window replaces the strategy's reduction with the
+    # pinned-order combine (that's the world-invariance pin above), so EF
+    # residuals do not ACCRUE during elastic training; what this test owns
+    # is the carry: plant a distinct per-worker residual stack and require
+    # the elastic run to thread it through every window unchanged
+    # (sgd.update), checkpoint it, and reshard it on the world-1 resume.
+    comm = jax.device_get(tr2.state.opt_state.comm)
+    planted = jax.tree.map(
+        lambda l: (np.arange(l.size, dtype=l.dtype).reshape(l.shape) / 64.0
+                   + np.arange(1, 3, dtype=l.dtype).reshape(
+                       (2,) + (1,) * (l.ndim - 1))),
+        comm["residual"])
+    tr2.state = tr2._commit_state(tr2.state._replace(
+        opt_state=tr2.state.opt_state._replace(
+            comm={"residual": planted})))
+    tr2.run(1, checkpoint_dir=ck)
+    r2 = [np.asarray(l) for l in jax.tree.leaves(
+        jax.device_get(tr2.state.opt_state.comm)["residual"])]
+    assert all(l.shape[0] == 2 for l in r2)
+    for got, want in zip(r2, jax.tree.leaves(planted)):
+        np.testing.assert_array_equal(got, want)   # carried, not mutated
+
+    # Epoch 0 is already checkpointed, so run(1) on the world-1 trainer
+    # restores + absorbs the state and trains nothing — the absorbed comm
+    # is exactly what the resume handed the next epoch.
+    tr1 = _elastic_trainer(tmp_path, 1, strategy="compress-bf16",
+                           limit_train_batches=3)
+    tr1.run(1, checkpoint_dir=ck)
+    r1 = [np.asarray(l) for l in jax.tree.leaves(
+        jax.device_get(tr1.state.opt_state.comm)["residual"])]
+    assert all(l.shape[0] == 1 for l in r1)
+    for old, new in zip(r2, r1):
+        np.testing.assert_array_equal(old.sum(axis=0), new[0])
+    # Params/momentum are world-invariant and restore bitwise.
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(tr2.state.params)[0]),
+        np.asarray(jax.tree.leaves(tr1.state.params)[0]))

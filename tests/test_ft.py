@@ -301,8 +301,9 @@ LIMIT = 7
 _CLEAN_STATE = {}
 
 
-def _trainer(tmp_path, mesh4, *, ft=None, limit=LIMIT, log=None):
-    return Trainer(model=tiny_cnn(), strategy="allreduce", mesh=mesh4,
+def _trainer(tmp_path, mesh4, *, ft=None, limit=LIMIT, log=None,
+             strategy="allreduce"):
+    return Trainer(model=tiny_cnn(), strategy=strategy, mesh=mesh4,
                    global_batch=64, data_dir=str(tmp_path), augment=True,
                    host_augment=True, limit_train_batches=limit,
                    log=log or (lambda s: None), ft=ft)
@@ -519,6 +520,45 @@ def test_chaos_preempt_mid_epoch_resume_is_bitwise(tmp_path, mesh4,
     assert peek.latest_epoch() == 0
     assert peek.latest_mid_epoch() is None
     peek.close()
+
+
+def test_preempt_resume_carries_compressed_residuals_bitwise(
+        tmp_path, mesh4, small_window):
+    """Round-7 pin: the error-feedback residual stack (opt_state.comm) is
+    part of the checkpointed TrainState — a preemption while residuals
+    are NONZERO resumes bitwise, including the rest of the epoch whose
+    arithmetic depends on the carried residuals."""
+    ck = str(tmp_path / "ck_comp")
+    lines = []
+
+    def small_eval(tr):
+        tr.test_split = cifar10.Split(tr.test_split.images[:64],
+                                      tr.test_split.labels[:64])
+        return tr
+
+    # Preempt EARLY (boundary poll at trained=3 on the WINDOW=3 grid): on
+    # this synthetic task the net later collapses to zero grads and the
+    # bf16 residuals decay to EXACT zero, which would make the
+    # nonzero-residual assertion below vacuous.
+    tr1 = small_eval(_trainer(
+        tmp_path, mesh4, strategy="compress-bf16", log=lines.append,
+        ft=FTConfig(chaos=ChaosPlan.parse(["preempt:2"]))))
+    tr1.run(1, checkpoint_dir=ck)
+    assert tr1.preempted is True
+    comm = jax.device_get(tr1.state.opt_state.comm)
+    assert any(np.any(np.asarray(l)) for l in jax.tree.leaves(comm)), \
+        "preempted too late: every EF residual already decayed to zero"
+
+    # Resume (no chaos) and finish; compare against never-interrupted.
+    tr2 = small_eval(_trainer(tmp_path, mesh4, strategy="compress-bf16",
+                              log=lines.append))
+    tr2.run(1, checkpoint_dir=ck)
+    assert any("Resumed from mid-epoch checkpoint" in ln for ln in lines)
+    tr0 = small_eval(_trainer(tmp_path, mesh4, strategy="compress-bf16"))
+    tr0.run(1)
+    # _assert_bitwise spans the WHOLE TrainState, comm residuals included.
+    _assert_bitwise(_host_state(tr2), _host_state(tr0))
+    assert jax.tree.leaves(tr2.state.opt_state.comm)[0].shape[0] == 4
 
 
 CHILD_SCRIPT = """\

@@ -220,7 +220,183 @@ def test_ddp_wallclock_not_slower_than_allreduce(mesh8):
 
 
 def test_strategy_registry():
-    assert set(strategies.STRATEGIES) == {"single", "gather", "allreduce",
-                                          "ddp"}
+    assert set(strategies.STRATEGIES) == {
+        "single", "gather", "allreduce", "ddp", "overlap",
+        "compress-bf16", "compress-int8", "powersgd"}
     with pytest.raises(ValueError):
         strategies.get_strategy("zero_redundancy")
+    assert strategies.get_strategy("powersgd").rank == \
+        strategies.DEFAULT_COMPRESS_RANK
+    assert strategies.get_strategy("powersgd", compress_rank=2).rank == 2
+    with pytest.raises(ValueError):
+        strategies.PowerSGD(rank=0)
+    with pytest.raises(ValueError):
+        strategies.CompressedPsum("fp4")
+
+
+# -- round-7 tiers: overlapped ddp + compressed collectives -------------------
+
+def run_stateful(mesh, strategy, grads_per_device, comm):
+    """Apply a stateful strategy with its per-worker comm state threaded;
+    returns (synced grads [replicated], new comm [stacked per worker])."""
+    f = shard_map(
+        lambda g, c: strategy(jax.tree.map(lambda a: a[0], g), DATA_AXIS,
+                              comm=c),
+        mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(DATA_AXIS)), **_SHARD_MAP_KW)
+    return jax.jit(f)(grads_per_device, comm)
+
+
+def test_overlap_computes_the_mean(mesh8, per_device_grads):
+    expected = jax.tree.map(lambda a: jnp.mean(a, 0), per_device_grads)
+    out = run_strategy(mesh8, strategies.get_strategy("overlap"),
+                       per_device_grads)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        out, expected)
+
+
+def test_overlapped_ddp_drops_the_barrier_chain(mesh8):
+    """The overlap tier is the ddp bucket plan WITHOUT the inter-bucket
+    optimization_barrier chain: at one leaf per bucket, ddp lowers
+    leaves-1 barriers while overlap lowers ZERO — each bucket's psum is
+    gated only by its own gradients (the StableHLO-level pin; the chain
+    DEPTH contract lives in analysis/audit.py's overlap rule)."""
+    grads = tree_of_grads(jax.random.PRNGKey(1))
+    stacked = jax.tree.map(lambda a: a[None].repeat(8, 0), grads)
+
+    def counts(strategy):
+        f = shard_map(lambda g: strategy(
+            jax.tree.map(lambda a: a[0], g), DATA_AXIS),
+            mesh=mesh8, in_specs=(P(DATA_AXIS),), out_specs=P(),
+            **_SHARD_MAP_KW)
+        hlo = jax.jit(f).lower(stacked).as_text()  # StableHLO MLIR
+        return (len(re.findall(r"stablehlo\.all_reduce", hlo)),
+                len(re.findall(r"stablehlo\.optimization_barrier", hlo)))
+
+    assert counts(strategies.get_strategy("ddp", bucket_bytes=64)) == (4, 3)
+    assert counts(strategies.get_strategy("overlap",
+                                          bucket_bytes=64)) == (4, 0)
+    # One 25MB bucket: same fused collective count as ddp, still no chain.
+    assert counts(strategies.get_strategy("overlap")) == (4, 0)
+
+
+def test_compressed_bf16_error_feedback(mesh8, per_device_grads):
+    """The bf16 tier's wire mean must track the true mean within bf16
+    rounding, the residual must be EXACTLY the untransmitted part
+    (v - bf16(v)), and carrying it forward must not let quantization
+    error accumulate across steps (the EF-SGD property)."""
+    strat = strategies.get_strategy("compress-bf16")
+    assert strat.stateful and strat.name == "compress-bf16"
+    local_like = jax.tree.map(lambda a: a[0], per_device_grads)
+    comm = strat.init_comm(local_like, 8)
+    expected = jax.tree.map(lambda a: jnp.mean(a, 0), per_device_grads)
+
+    out, new_comm = run_stateful(mesh8, strat, per_device_grads, comm)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=0, atol=2e-2),
+        out, expected)
+    # Residual == what this worker failed to transmit, bitwise.
+    jax.tree.map(
+        lambda g, r: np.testing.assert_array_equal(
+            np.asarray(r),
+            np.asarray(g.astype(jnp.float32)
+                       - g.astype(jnp.bfloat16).astype(jnp.float32))),
+        per_device_grads, new_comm["residual"])
+
+    # Constant grads, residuals carried: the time-average of the synced
+    # outputs converges on the true mean instead of repeating one step's
+    # rounding error.
+    outs, comm_t = [out], new_comm
+    for _ in range(3):
+        o, comm_t = run_stateful(mesh8, strat, per_device_grads, comm_t)
+        outs.append(o)
+    leaves_e = jax.tree.leaves(expected)
+    for i, le in enumerate(leaves_e):
+        avg = np.mean([np.asarray(jax.tree.leaves(o)[i]) for o in outs],
+                      axis=0)
+        one = np.max(np.abs(np.asarray(jax.tree.leaves(outs[0])[i]) - le))
+        assert np.max(np.abs(avg - np.asarray(le))) <= one + 1e-6
+
+
+def test_compressed_int8_shared_scale_never_overflows(mesh8):
+    """Every worker at +amax is the wire's worst case: a naive per-worker
+    127 scale (or an unclipped round at scale amax*world/127) sums past
+    int8's 127 and wraps the mean NEGATIVE.  The shared pmax'd scale with
+    the clip at L = 127 // world keeps the sum bounded — identical grads
+    come back exactly, sign preserved."""
+    g = {"w": jnp.full((4, 4), 3.0, jnp.float32),
+         "b": jnp.full((2,), -3.0, jnp.float32)}
+    stacked = jax.tree.map(lambda a: a[None].repeat(8, 0), g)
+    strat = strategies.get_strategy("compress-int8")
+    comm = strat.init_comm(g, 8)
+    out, new_comm = run_stateful(mesh8, strat, stacked, comm)
+    # amax=3, L=15, scale=1/5: v/scale = +-15 on the nose -> exact.
+    np.testing.assert_allclose(np.asarray(out["w"]), 3.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["b"]), -3.0, rtol=1e-6)
+    jax.tree.map(lambda r: np.testing.assert_allclose(
+        np.asarray(r), 0.0, atol=1e-6), new_comm["residual"])
+
+    # Mixed magnitudes still stay within quantization distance of the
+    # true mean (one scale step = amax / (127 // world)).
+    keys = jax.random.split(jax.random.PRNGKey(3), 8)
+    rand = jax.tree.map(
+        lambda a: jnp.stack([jax.random.normal(k, a.shape) for k in keys]),
+        g)
+    expected = jax.tree.map(lambda a: jnp.mean(a, 0), rand)
+    out2, _ = run_stateful(mesh8, strat, rand,
+                           strat.init_comm(g, 8))
+    amax = max(float(jnp.max(jnp.abs(l))) for l in jax.tree.leaves(rand))
+    step = amax / (127 // 8)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=0, atol=step),
+        out2, expected)
+
+
+def test_powersgd_rank1_reconstruction_and_determinism(mesh8):
+    """A rank-1 matrix is inside the rank-4 subspace, so one power-iteration
+    step reconstructs it to float precision (residual ~ 0); vector leaves
+    ride the bf16 fallback; and the whole tier is deterministic — a fresh
+    run from the same comm state is bitwise identical."""
+    u = jax.random.normal(jax.random.PRNGKey(17), (24,))
+    vv = jax.random.normal(jax.random.PRNGKey(18), (6,))
+    g = {"w": jnp.outer(u, vv), "b": jnp.arange(6, dtype=jnp.float32)}
+    stacked = jax.tree.map(lambda a: a[None].repeat(8, 0), g)
+    strat = strategies.get_strategy("powersgd")
+    assert strat._low_rank(g["w"].shape) and not strat._low_rank(
+        g["b"].shape)
+    comm = strat.init_comm(g, 8)
+    assert set(comm) == {"residual", "q"}
+
+    out1, comm1 = run_stateful(mesh8, strat, stacked, comm)
+    np.testing.assert_allclose(np.asarray(out1["w"]), np.asarray(g["w"]),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(comm1["residual"]["w"]), 0.0,
+                               atol=1e-4)
+    # bf16 fallback leaf: mean within bf16 rounding.
+    np.testing.assert_allclose(np.asarray(out1["b"]), np.asarray(g["b"]),
+                               rtol=2e-2, atol=1e-3)
+
+    out2, comm2 = run_stateful(mesh8, strat, stacked, comm)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), out1, out2)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), comm1, comm2)
+
+
+def test_reshard_comm_conserves_residual_mass():
+    """Elastic world resize: the total undelivered error-feedback mass is
+    invariant (2 -> 1 -> 3), and PowerSGD Q factors stay replicated."""
+    comm = {
+        "residual": {"w": jnp.asarray([[1.0, -2.0], [3.0, 0.5]])},
+        "q": {"000": jnp.repeat(jnp.asarray([[1.0, 2.0]])[None], 2, 0)},
+    }
+    down = strategies.reshard_comm(comm, 1)
+    np.testing.assert_allclose(np.asarray(down["residual"]["w"]),
+                               [[4.0, -1.5]])
+    up = strategies.reshard_comm(down, 3)
+    assert up["residual"]["w"].shape == (3, 2)
+    np.testing.assert_allclose(
+        np.asarray(up["residual"]["w"]).sum(0), [4.0, -1.5], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(up["q"]["000"]),
+                               np.repeat([[[1.0, 2.0]]], 3, 0))
