@@ -68,7 +68,14 @@ tests pin both.  The full payload carries
     p50/p95/p99 under a seeded open-loop arrival trace at 2-3 offered
     loads, and COLD vs WARM startup seconds measured in fresh
     subprocesses sharing one executable-cache dir (the warm-start
-    acceptance bar: warm < 0.5 x cold).
+    acceptance bar: warm < 0.5 x cold), and
+  * ``attribution`` — the round-8 performance-attribution sheet
+    (``run_attribution``): the static cost model
+    (``analysis/costmodel.py``) over every zoo program's lowering
+    (analytic FLOPs/HBM/wire bytes -> roofline bound, MFU ceiling,
+    comm/compute ratio; overlap's exposed-comm bound vs ddp's chained
+    plan) plus a measured MFU join of the headline windowed program's
+    steady-state wall clock against its own audited lowering.
 
 Protocol (BASELINE.md): the reference's own measurement design — windowed
 wall-clock fenced by fetching the loss values, the first window (compile +
@@ -98,7 +105,10 @@ TORCH_CPU_BASELINE_IPS = 38.9
 
 # TPU v5e: 197 TFLOP/s bf16 peak per chip (the MFU denominator; f32 configs
 # use the same denominator since TPU f32 matmuls run bf16 multiply passes).
-V5E_BF16_PEAK_FLOPS = 197e12
+# Single source: analysis/costmodel.py (jax-free), shared with the MFU and
+# roofline tooling so the constant cannot drift between reports.
+from cs744_ddp_tpu.analysis.costmodel import (  # noqa: E402
+    V5E_BF16_PEAK_FLOPS, mfu_fields as _costmodel_mfu_fields)
 
 MODELS = ("vgg11", "resnet18")
 STRATEGIES = ("gather", "allreduce", "ddp")
@@ -151,12 +161,10 @@ def _throughput(model: str, strategy: str, num_devices, *, global_batch: int,
 
 
 def _mfu_fields(ips_per_chip: float, flops_per_image) -> dict:
-    """tflops_per_sec / mfu_vs_bf16_peak for one chip's throughput."""
-    if not flops_per_image:
-        return {}
-    tflops = ips_per_chip * flops_per_image / 1e12
-    return {"tflops_per_sec": round(tflops, 2),
-            "mfu_vs_bf16_peak": round(tflops * 1e12 / V5E_BF16_PEAK_FLOPS, 4)}
+    """tflops_per_sec / mfu_vs_bf16_peak for one chip's throughput
+    (delegates to analysis/costmodel.mfu_fields — the one copy of the
+    arithmetic and rounding)."""
+    return _costmodel_mfu_fields(ips_per_chip, flops_per_image)
 
 
 def _matrix_pairs(ndev: int, models, strategies, deep_rows):
@@ -956,8 +964,31 @@ def run_compression(log, *, headline_model: str = "vgg11", ndev=None,
     return out
 
 
+def _zoo_result(log, *, headline_model: str, global_batch: int,
+                collect_hlo: bool = False):
+    """Lower + audit the shipped-program zoo once (shared by the audit
+    and attribution sections — one set of lowerings feeds both); None
+    with a logged reason on failure."""
+    import jax
+
+    from cs744_ddp_tpu.analysis import audit as auditlib
+
+    ndev = len(jax.devices())
+    log(f"[bench] audit: program zoo for {headline_model} on {ndev} "
+        "device(s)")
+    try:
+        return auditlib.audit_zoo(model=headline_model,
+                                  global_batch=global_batch,
+                                  serve_buckets=(1, 8),
+                                  num_devices=ndev,
+                                  collect_hlo=collect_hlo)
+    except Exception as e:   # noqa: BLE001 - advisory section
+        log(f"[bench] audit: zoo audit failed ({e!r}); section omitted")
+        return None
+
+
 def run_audit(log, *, headline_model: str = "vgg11",
-              global_batch: int = 256) -> Optional[dict]:
+              global_batch: int = 256, zoo=None) -> Optional[dict]:
     """Static program audit (``cs744_ddp_tpu/analysis/audit.py``) over the
     full shipped-program zoo on THIS host's devices: every train path x
     strategy, the eval window and the serving ladder, certified against
@@ -966,25 +997,80 @@ def run_audit(log, *, headline_model: str = "vgg11",
     bench artifact carries the certification next to the numbers it
     certifies.  None (with a logged reason) when auditing fails — the
     section is advisory, never fatal to a finished measurement run."""
-    import jax
-
-    from cs744_ddp_tpu.analysis import audit as auditlib
-
     log = log or (lambda s: print(s, file=sys.stderr))
-    ndev = len(jax.devices())
-    log(f"[bench] audit: program zoo for {headline_model} on {ndev} "
-        "device(s)")
-    try:
-        res = auditlib.audit_zoo(model=headline_model,
-                                 global_batch=global_batch,
-                                 serve_buckets=(1, 8),
-                                 num_devices=ndev)
-    except Exception as e:   # noqa: BLE001 - advisory section
-        log(f"[bench] audit: zoo audit failed ({e!r}); section omitted")
+    res = zoo if zoo is not None else _zoo_result(
+        log, headline_model=headline_model, global_batch=global_batch)
+    if res is None:
         return None
     for line in res.format_lines():
         log(f"[bench] {line}")
     return res.summary()
+
+
+def run_attribution(log, *, headline_model: str = "vgg11",
+                    headline_strategy: str = "ddp", ndev=None,
+                    global_batch: int = 256, data_dir: str = "./data",
+                    max_iters: int = 100, zoo=None) -> Optional[dict]:
+    """Performance attribution (round 8): the static cost model
+    (``analysis/costmodel.py``) walked over every zoo program's lowering
+    — analytic FLOPs, HBM bytes, collective wire bytes -> per-program
+    roofline bound, MFU ceiling and comm/compute ratio — plus a MEASURED
+    join on the headline windowed program: per-dispatch wall clock from a
+    real steady-state run against the same program's analytic flops,
+    yielding achieved MFU on the numbers the audit section certifies.
+    The ``overlap`` tier additionally reports its exposed-communication
+    upper bound against ``ddp``'s chained plan.  None (logged reason)
+    when any leg fails — advisory, never fatal."""
+    import jax
+
+    from cs744_ddp_tpu.analysis import audit as auditlib
+    from cs744_ddp_tpu.analysis import costmodel
+    from cs744_ddp_tpu.obs import attribution as attrlib
+
+    log = log or (lambda s: print(s, file=sys.stderr))
+    ndev = ndev or len(jax.devices())
+    res = zoo
+    if res is None or not res.hlo:
+        res = _zoo_result(log, headline_model=headline_model,
+                          global_batch=global_batch, collect_hlo=True)
+    if res is None:
+        return None
+    try:
+        out = auditlib.zoo_attribution(res)
+    except Exception as e:   # noqa: BLE001 - advisory section
+        log(f"[bench] attribution: static leg failed ({e!r}); "
+            "section omitted")
+        return None
+    log(f"[bench] attribution: {len(out['programs'])} programs "
+        "cost-modeled")
+
+    # Measured join: steady-state per-step wall clock of the headline
+    # windowed program vs the SAME lowering's analytic per-device flops.
+    prog = f"train/window/{headline_strategy}"
+    try:
+        rep = costmodel.cost_report(res.hlo[prog], prog)
+        trips = max(rep.trip_counts.values(), default=1)
+        log(f"[bench] attribution: measured join on {prog} "
+            f"({headline_model}, {ndev} device(s))")
+        trainer = _make_trainer(headline_model, headline_strategy, ndev,
+                                global_batch=global_batch,
+                                data_dir=data_dir, log=lambda s: None)
+        ips_per_chip = trainer.steady_state_throughput(
+            max_iters=max_iters, window_iters="epoch")[1]
+        step_s = global_batch / (ips_per_chip * ndev)
+        out["measured"] = {
+            "protocol": f"{headline_model}/{headline_strategy} on {ndev} "
+                        f"device(s), global batch {global_batch}; "
+                        "steady-state per-step wall clock vs the audited "
+                        "window lowering's per-device analytic flops",
+            "images_per_sec_per_chip": round(ips_per_chip, 2),
+            **attrlib.attribute(rep, measured_s=step_s * trips),
+        }
+    except Exception as e:   # noqa: BLE001 - advisory section
+        log(f"[bench] attribution: measured join failed ({e!r}); "
+            "static leg kept")
+        out.pop("measured", None)
+    return out
 
 
 def run_bench(*, matrix: bool = True, sweep: bool = True,
@@ -995,6 +1081,7 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
               robustness: bool = True, serving: bool = True,
               elastic: bool = True,
               audit: bool = True,
+              attribution: bool = True,
               serving_kwargs=None,
               max_iters: int = 100,
               global_batch: int = 256,
@@ -1324,13 +1411,26 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
             global_batch=global_batch, data_dir=data_dir,
             max_iters=max_iters)
 
-    # Static program audit: the zoo's cost-shape certification rides in
-    # the artifact next to the measurements it certifies.
-    if audit:
-        audit_summary = run_audit(log, headline_model=headline_model,
-                                  global_batch=global_batch)
-        if audit_summary is not None:
-            result["audit"] = audit_summary
+    # Static program audit + cost-model attribution: ONE set of zoo
+    # lowerings feeds both sections — the certification and the cost
+    # numbers cannot drift apart.
+    if audit or attribution:
+        zoo = _zoo_result(log, headline_model=headline_model,
+                          global_batch=global_batch,
+                          collect_hlo=attribution)
+        if audit:
+            audit_summary = run_audit(log, headline_model=headline_model,
+                                      global_batch=global_batch, zoo=zoo)
+            if audit_summary is not None:
+                result["audit"] = audit_summary
+        if attribution:
+            attr = run_attribution(
+                log, headline_model=headline_model,
+                headline_strategy=headline_strategy, ndev=ndev,
+                global_batch=global_batch, data_dir=data_dir,
+                max_iters=max_iters, zoo=zoo)
+            if attr is not None:
+                result["attribution"] = attr
 
     if sweep:
         # WEAK scaling: per-chip batch held at ``global_batch`` while the
@@ -1497,6 +1597,11 @@ def main(argv=None) -> None:
     p.add_argument("--no-audit", action="store_true",
                    help="skip the static program-zoo audit section "
                         "(analysis/audit.py cost-shape certification)")
+    p.add_argument("--no-attribution", action="store_true",
+                   help="skip the cost-model attribution section "
+                        "(analysis/costmodel.py analytic FLOPs/bytes per "
+                        "zoo program + the measured MFU join on the "
+                        "headline windowed program)")
     p.add_argument("--max-iters", type=int, default=100,
                    help="minimum steady-state iterations per config")
     p.add_argument("--global-batch", type=int, default=256)
@@ -1538,6 +1643,8 @@ def main(argv=None) -> None:
                        serving=not (args.no_serving or args.no_matrix),
                        elastic=not (args.no_elastic or args.no_matrix),
                        audit=not (args.no_audit or args.no_matrix),
+                       attribution=not (args.no_attribution
+                                        or args.no_matrix),
                        max_iters=args.max_iters,
                        global_batch=args.global_batch)
     emit_result(result, args.full_out or os.path.join(

@@ -405,6 +405,10 @@ class AuditResult:
     reports: List[AuditReport] = field(default_factory=list)
     ladder: Dict = field(default_factory=dict)
     ladder_findings: List[Finding] = field(default_factory=list)
+    # Program name -> pre-optimization HLO text, kept only when the caller
+    # asks (``collect_hlo``) — the attribution pipeline (analysis/costmodel)
+    # re-walks the same lowerings the audit certified.
+    hlo: Dict[str, str] = field(default_factory=dict)
 
     @property
     def clean(self) -> bool:
@@ -488,9 +492,12 @@ def _certify_ladder(depths: Dict[str, int], nleaves: int, nbuckets: int,
     return ladder, findings
 
 
-def _train_sds(mesh, state_sds, global_batch: int, window: int):
+def _train_sds(mesh, state_sds, global_batch: int, window: int,
+               ring_capacity: int = 0):
     """ShapeDtypeStructs for the train step/window/eval signatures on
-    ``mesh`` (mirrors the Trainer's staging shapes)."""
+    ``mesh`` (mirrors the Trainer's staging shapes).  ``ring_capacity``
+    > 0 adds the metric-ring pair (obs/ringbuf.py) the ring-carrying
+    window variants take as their donated second argument."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -510,9 +517,16 @@ def _train_sds(mesh, state_sds, global_batch: int, window: int):
         # worker owns its slice — mirror the Trainer's placement.
         state = state._replace(opt_state=state.opt_state._replace(
             comm=jax.tree_util.tree_map(lambda s: share(s, row), comm)))
+    ring = None
+    if ring_capacity:
+        from ..obs import ringbuf
+        ring = (jax.ShapeDtypeStruct((ring_capacity, ringbuf.N_METRICS),
+                                     jnp.float32, sharding=rep),
+                jax.ShapeDtypeStruct((), jnp.int32, sharding=rep))
     b, w = global_batch, window
     return {
         "state": state,
+        "ring": ring,
         "key": jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep),
         "images": jax.ShapeDtypeStruct((b, 32, 32, 3), jnp.uint8,
                                        sharding=row),
@@ -543,10 +557,20 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
               num_devices: Optional[int] = None,
               waive: Sequence[str] = (),
               max_constant_bytes: int = DEFAULT_MAX_CONSTANT_BYTES,
+              metrics_ring: bool = True,
+              collect_hlo: bool = False,
               ) -> AuditResult:
     """Lower and audit the shipped program zoo: the 3 train paths for
     each strategy, the eval window, and (when ``serve_buckets`` is
     non-empty) the serving executable ladder.
+
+    ``metrics_ring`` (default on, matching the Trainer) lowers the
+    windowed paths in their ring-carrying form — the programs the Trainer
+    actually dispatches — so the donation floor rises by the 2 ring
+    buffers and the host-sync rule certifies that the per-step ring
+    writes stay pure dynamic-update-slices (no host round-trip inside
+    the scanned body).  ``collect_hlo`` keeps every program's lowering
+    text on the result (``AuditResult.hlo``) for cost-model attribution.
 
     Lowering is ABSTRACT end to end — train state shapes come from
     ``jax.eval_shape`` so no parameters are materialized; only the
@@ -556,6 +580,7 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
     import jax
 
     from ..models import get_model
+    from ..obs import ringbuf
     from ..ops import sgd
     from ..parallel import get_strategy, mesh as meshlib
     from ..parallel.bucketing import DEFAULT_BUCKET_BYTES, make_plan
@@ -628,9 +653,11 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
             jax.random.PRNGKey(0))
         n_state = len(jax.tree_util.tree_leaves(st_sds))
         ratio = _compress_ratio(strategy, strat)
-        sds = _train_sds(mesh, st_sds, b, window)
+        ring_cap = ringbuf.DEFAULT_CAPACITY if metrics_ring else 0
+        sds = _train_sds(mesh, st_sds, b, window, ring_capacity=ring_cap)
         for path in paths:
             name = f"train/{path}/{strategy}"
+            ring = metrics_ring and path in ("window", "host_window")
             if path == "step":
                 fn = steplib.make_train_step(
                     apply_fn, strat, mesh, sgd_cfg, augment=True,
@@ -641,16 +668,25 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
             else:
                 fn = steplib.make_train_window(
                     apply_fn, strat, mesh, sgd_cfg,
-                    augment=(path == "window"), compute_dtype=compute_dtype)
-                args = (sds["state"], sds["key"], sds["epoch_images"],
-                        sds["epoch_labels"], sds["start"], sds["lengths"])
+                    augment=(path == "window"), compute_dtype=compute_dtype,
+                    metrics_ring=ring)
+                head = ((sds["state"], sds["ring"]) if ring
+                        else (sds["state"],))
+                args = head + (sds["key"], sds["epoch_images"],
+                               sds["epoch_labels"], sds["start"],
+                               sds["lengths"])
                 donates = True
+            # The ring pair is donated alongside the state, so the
+            # donation floor rises by its 2 entry buffers.
+            n_floor = n_state + (2 if ring else 0)
             text = _hlo_text(fn.lower(*args))
             jaxpr = (jax.make_jaxpr(fn)(*args)
                      if path == "window" else None)
             result.reports.append(audit_program(
-                text, contract(name, strategy, w, donates, n_state, ratio),
+                text, contract(name, strategy, w, donates, n_floor, ratio),
                 jaxpr, waive=waive))
+            if collect_hlo:
+                result.hlo[name] = text
             if path == "window":
                 window_depths[strategy] = \
                     result.reports[-1].stats["chain_depth"]
@@ -667,12 +703,15 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
             text, contract("eval/window", "eval", world, False,
                            len(jax.tree_util.tree_leaves(state_sds)), 1.0),
             jax.make_jaxpr(ev)(*args), waive=waive))
+        if collect_hlo:
+            result.hlo["eval/window"] = text
 
     if serve_buckets:
         result.reports.extend(audit_serving(
             model=model, buckets=serve_buckets,
             precision=serve_precision or precision, waive=waive,
-            max_constant_bytes=max_constant_bytes))
+            max_constant_bytes=max_constant_bytes,
+            hlo_out=result.hlo if collect_hlo else None))
 
     if world > 1 and len(window_depths) > 1:
         result.ladder, result.ladder_findings = _certify_ladder(
@@ -689,12 +728,14 @@ def audit_serving(*, model: str = "vgg11",
                   precision: str = "f32", engine=None,
                   waive: Sequence[str] = (),
                   max_constant_bytes: int = DEFAULT_MAX_CONSTANT_BYTES,
+                  hlo_out: Optional[Dict[str, str]] = None,
                   ) -> List[AuditReport]:
     """Audit the serving executable ladder: one single-device program per
     bucket, required collective-free, precision-certified, constant-lean.
     Pass ``engine`` to audit an already-built :class:`InferenceEngine`
     (the bench serving section does); otherwise one is built without
-    staging or caches."""
+    staging or caches.  ``hlo_out`` (a dict) collects each rung's
+    lowering text under its program name for cost-model attribution."""
     if engine is None:
         from ..serve import InferenceEngine
         engine = InferenceEngine(model, buckets=tuple(buckets),
@@ -703,11 +744,14 @@ def audit_serving(*, model: str = "vgg11",
                                  enable_compilation_cache=False)
     reports = []
     for b in engine.buckets:
+        name = f"serve/b{b}/{precision}"
         c = ProgramContract(
-            name=f"serve/b{b}/{precision}", strategy=None, world=1,
+            name=name, strategy=None, world=1,
             precision=precision, max_constant_bytes=max_constant_bytes)
-        reports.append(audit_program(
-            engine.lowered_hlo(b, precision), c, waive=waive))
+        text = engine.lowered_hlo(b, precision)
+        reports.append(audit_program(text, c, waive=waive))
+        if hlo_out is not None:
+            hlo_out[name] = text
     return reports
 
 
@@ -718,3 +762,34 @@ def record_audit(telemetry, result: AuditResult) -> None:
     if not getattr(telemetry, "enabled", False):
         return
     telemetry.update_manifest({"audit": result.summary()})
+
+
+def zoo_attribution(result: AuditResult) -> Dict:
+    """Static cost-model attribution over an audited zoo's lowerings
+    (requires ``audit_zoo(..., collect_hlo=True)``): per-program analytic
+    FLOPs / HBM / wire bytes -> roofline attribution, plus the
+    overlap-vs-ddp exposed-communication bound when both tiers are
+    present.  Pure static analysis — no dispatch, no devices."""
+    from . import costmodel
+    from ..obs import attribution as attrlib
+    if not result.hlo:
+        raise ValueError("audit result carries no HLO text; re-run "
+                         "audit_zoo(..., collect_hlo=True)")
+    reports = {name: costmodel.cost_report(text, name)
+               for name, text in result.hlo.items()}
+    programs = {name: attrlib.attribute(rep)
+                for name, rep in reports.items()}
+    out: Dict = {"programs": programs}
+    ov, dd = (reports.get("train/window/overlap"),
+              reports.get("train/window/ddp"))
+    if ov is not None and dd is not None:
+        out["overlap_vs_ddp"] = attrlib.overlap_vs_ddp(ov, dd)
+    return out
+
+
+def record_attribution(telemetry, attribution: Dict) -> None:
+    """Attach a :func:`zoo_attribution` record to the run manifest; the
+    disabled recorder path allocates and touches NOTHING."""
+    if not getattr(telemetry, "enabled", False):
+        return
+    telemetry.update_manifest({"attribution": attribution})

@@ -106,6 +106,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="save TrainState after each epoch and auto-resume "
                         "from the latest checkpoint (beyond-parity: the "
                         "reference has no checkpointing)")
+    p.add_argument("--metrics-ring", type=int, default=None, metavar="N",
+                   help="device-resident metric ring capacity for the "
+                        "windowed train paths (obs/ringbuf.py): per-step "
+                        "loss/grad-norm/ok rows are written on device and "
+                        "drained ONCE per window instead of per step. "
+                        "Default on (capacity 64); 0 disables (per-step "
+                        "fetch of stacked window losses); N >= 20 sets "
+                        "the capacity")
     p.add_argument("--telemetry-out", default=None,
                    help="write structured run telemetry to this directory: "
                         "manifest.json (run header), events.jsonl (per-step "
@@ -260,16 +268,25 @@ def _apply_audit(args, telemetry, result) -> None:
 
 
 def audit_main(args, telemetry) -> None:
-    """--audit-zoo: certify the full shipped-program matrix and exit."""
+    """--audit-zoo: certify the full shipped-program matrix and exit.
+    With an enabled recorder the same lowerings also get a static
+    cost-model attribution pass (analysis/costmodel) recorded under
+    manifest["attribution"] — audit and attribution read ONE set of
+    programs, so they cannot drift."""
     from .analysis import audit as auditlib
     from .serve import demo
 
+    collect = getattr(telemetry, "enabled", False)
     result = auditlib.audit_zoo(
         model=args.model, global_batch=args.batch_size,
         precision=args.precision,
         serve_buckets=demo.parse_buckets(args.serve_buckets),
         serve_precision=args.serve_precision,
-        num_devices=args.num_devices, waive=args.audit_waive or ())
+        num_devices=args.num_devices, waive=args.audit_waive or (),
+        metrics_ring=args.metrics_ring != 0, collect_hlo=collect)
+    if collect:
+        auditlib.record_attribution(
+            telemetry, auditlib.zoo_attribution(result))
     _apply_audit(args, telemetry, result)
 
 
@@ -305,6 +322,7 @@ def elastic_main(args, telemetry) -> None:
                                   weight_decay=args.weight_decay),
             limit_train_batches=args.limit_train_batches,
             limit_eval_batches=args.limit_eval_batches,
+            metrics_ring=args.metrics_ring,
             telemetry=telemetry, ft=ft, elastic=args.elastic)
 
     coord = ElasticCoordinator(
@@ -412,6 +430,7 @@ def main(argv=None) -> None:
         host_augment=args.host_augment,
         limit_train_batches=args.limit_train_batches,
         limit_eval_batches=args.limit_eval_batches,
+        metrics_ring=args.metrics_ring,
         telemetry=telemetry,
         ft=ft_config_from_args(args),
     )
@@ -428,7 +447,8 @@ def main(argv=None) -> None:
                 precision=args.precision,
                 strategies=(args.strategy,),
                 num_devices=args.num_devices,
-                waive=args.audit_waive or ()))
+                waive=args.audit_waive or (),
+                metrics_ring=bool(trainer.metrics_ring)))
         trainer.run(args.epochs, checkpoint_dir=args.checkpoint_dir,
                     profile_dir=args.profile_dir)
     finally:

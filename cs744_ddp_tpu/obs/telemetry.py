@@ -55,28 +55,49 @@ def atomic_write_json(path: str, obj, indent: Optional[int] = 2) -> None:
             os.unlink(tmp)
 
 
+def _rotated_paths(path: str) -> List[str]:
+    """The rotated set behind ``path``, OLDEST FIRST: ``events.N.jsonl``
+    down to ``events.1.jsonl`` (rotation keeps the numbering contiguous,
+    so the scan stops at the first hole)."""
+    base, ext = os.path.splitext(path)
+    found = []
+    n = 1
+    while os.path.exists(f"{base}.{n}{ext}"):
+        found.append(f"{base}.{n}{ext}")
+        n += 1
+    return list(reversed(found))
+
+
 def read_events_jsonl(path: str,
                       warn=None) -> Tuple[List[Dict[str, Any]], int]:
-    """Read an events.jsonl -> (events, n_bad).  A run killed mid-write
+    """Read an events.jsonl -> (events, n_bad), INCLUDING any rotated
+    predecessors (``events.N.jsonl`` ... ``events.1.jsonl``, oldest
+    first — size-aware rotation, round 8).  A run killed mid-write
     (preemption is a NORMAL exit path for this codebase) legitimately
     leaves a truncated final line; undecodable lines are counted and
     reported through ``warn`` (callable, e.g. ``log``) instead of failing
     the whole report."""
     events: List[Dict[str, Any]] = []
     n_bad = 0
-    if not os.path.exists(path):
-        return events, n_bad
-    with open(path) as f:
-        for lineno, line in enumerate(f, 1):
-            if not line.strip():
-                continue
-            try:
-                events.append(json.loads(line))
-            except json.JSONDecodeError:
-                n_bad += 1
-                if warn is not None:
-                    warn(f"{path}:{lineno}: undecodable event line "
-                         f"(truncated write?) — skipped")
+
+    def _read_one(p: str) -> None:
+        nonlocal n_bad
+        with open(p) as f:
+            for lineno, line in enumerate(f, 1):
+                if not line.strip():
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    n_bad += 1
+                    if warn is not None:
+                        warn(f"{p}:{lineno}: undecodable event line "
+                             f"(truncated write?) — skipped")
+
+    for p in _rotated_paths(path):
+        _read_one(p)
+    if os.path.exists(path):
+        _read_one(path)
     return events, n_bad
 
 
@@ -201,7 +222,14 @@ class Telemetry:
 
     enabled = True
 
-    def __init__(self, out_dir: Optional[str] = None):
+    def __init__(self, out_dir: Optional[str] = None, *,
+                 rotate_bytes: int = 64 * 2 ** 20, rotate_keep: int = 3):
+        """``rotate_bytes`` caps the live ``events.jsonl``: past it the
+        file rotates to ``events.1.jsonl`` (older generations shift up,
+        at most ``rotate_keep`` kept) so a multi-hour run cannot grow the
+        log unbounded.  0 disables rotation.  The 64 MiB default is far
+        above any CI run — short runs never rotate (the run-directory
+        listing stays exactly its three files)."""
         self.out_dir = out_dir
         self.records: List[Dict[str, Any]] = []  # in-memory mirror when no dir
         self.manifest: Optional[Dict[str, Any]] = None
@@ -210,10 +238,18 @@ class Telemetry:
         self._lock = threading.Lock()  # producer thread emits spans too
         self._tls = threading.local()
         self._counters: Dict[str, float] = {}
+        if rotate_keep < 1:
+            raise ValueError(f"rotate_keep must be >= 1, got {rotate_keep}")
+        self._rotate_bytes = int(rotate_bytes)
+        self._rotate_keep = int(rotate_keep)
+        self._events_path: Optional[str] = None
+        self._event_bytes = 0
         if out_dir is not None:
             os.makedirs(out_dir, exist_ok=True)
-            self._fh = open(os.path.join(out_dir, "events.jsonl"), "a",
-                            buffering=1)
+            self._events_path = os.path.join(out_dir, "events.jsonl")
+            if os.path.exists(self._events_path):   # append to a prior run
+                self._event_bytes = os.path.getsize(self._events_path)
+            self._fh = open(self._events_path, "a", buffering=1)
 
     # -- span stack (per thread) -------------------------------------------
 
@@ -236,9 +272,32 @@ class Telemetry:
     def _emit(self, rec: Dict[str, Any]) -> None:
         with self._lock:
             if self._fh is not None:
-                self._fh.write(json.dumps(rec) + "\n")
+                line = json.dumps(rec) + "\n"
+                self._fh.write(line)
+                self._event_bytes += len(line)
+                if self._rotate_bytes and \
+                        self._event_bytes >= self._rotate_bytes:
+                    self._rotate_locked()
             else:
                 self.records.append(rec)
+
+    def _rotate_locked(self) -> None:
+        """Shift the rotated generations up one slot (dropping the one
+        past ``rotate_keep``) and reopen a fresh live file.  Caller holds
+        the lock; every move is an ``os.replace`` so a crash mid-rotation
+        leaves whole files, never torn ones."""
+        self._fh.close()
+        base, ext = os.path.splitext(self._events_path)
+        oldest = f"{base}.{self._rotate_keep}{ext}"
+        if os.path.exists(oldest):
+            os.unlink(oldest)
+        for k in range(self._rotate_keep - 1, 0, -1):
+            src = f"{base}.{k}{ext}"
+            if os.path.exists(src):
+                os.replace(src, f"{base}.{k + 1}{ext}")
+        os.replace(self._events_path, f"{base}.1{ext}")
+        self._fh = open(self._events_path, "a", buffering=1)
+        self._event_bytes = 0
 
     def step(self, *, epoch: int, iter: int, loss: float, step_time: float,
              forward_time: Optional[float] = None, steady: bool = True,
@@ -373,6 +432,25 @@ def summarize_events(events: List[Dict[str, Any]],
     }
     if ranks:
         summary["ranks"] = ranks
+    # Serving latency split (round 8): the per-request queue-wait vs
+    # service-time gauges the micro-batcher emits, aggregated so SLO
+    # reading needs only the summary.
+    qw = [e["value"] for e in events if e.get("kind") == "gauge"
+          and e.get("name") == "serve_queue_wait_ms"]
+    svc = [e["value"] for e in events if e.get("kind") == "gauge"
+           and e.get("name") == "serve_service_ms"]
+    if qw or svc:
+        def _pct(vals):
+            if not vals:
+                return None
+            return {"p50": percentile(vals, 50),
+                    "p95": percentile(vals, 95),
+                    "mean": sum(vals) / len(vals)}
+        summary["serving_latency_split"] = {
+            "requests": max(len(qw), len(svc)),
+            "queue_wait_ms": _pct(qw),
+            "service_ms": _pct(svc),
+        }
     if steps:
         summary["final_loss"] = steps[-1]["loss"]
         summary["mean_loss"] = sum(s["loss"] for s in steps) / len(steps)

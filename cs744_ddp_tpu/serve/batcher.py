@@ -27,10 +27,18 @@ Telemetry: spans ``serve_enqueue`` -> ``serve_batch`` (assembly) ->
 ``queue_depth`` (images waiting) and ``serve_latency_ms`` per request
 (attr ``bucket``), counters ``serve_bucket_<B>`` — all guarded on
 ``telemetry.enabled`` so the NULL recorder path allocates nothing.
+
+Causality (round 8): every request gets a process-unique ``trace`` id at
+submit; the enqueue span carries it, the batch/dispatch/fetch spans carry
+the riding batch's full ``traces`` list, and two per-request gauges split
+end-to-end latency into ``serve_queue_wait_ms`` (enqueue -> dispatch
+start) vs ``serve_service_ms`` (dispatch start -> logits handed back) —
+the instrumentation ROADMAP item 1's SLO curves read.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from concurrent.futures import Future
@@ -118,15 +126,28 @@ def plan_batches(trace: Sequence[Tuple[float, int]],
     return plan
 
 
-class _Request:
-    __slots__ = ("images", "labels", "future", "t_enqueue", "n")
+_trace_lock = threading.Lock()
+_trace_counter = itertools.count(1)
 
-    def __init__(self, images, labels):
+
+def next_trace_id() -> int:
+    """Process-unique request trace id — the causality key threaded
+    through enqueue -> batch -> dispatch -> fetch spans and the
+    per-request latency-split gauges."""
+    with _trace_lock:
+        return next(_trace_counter)
+
+
+class _Request:
+    __slots__ = ("images", "labels", "future", "t_enqueue", "n", "trace")
+
+    def __init__(self, images, labels, trace: int):
         self.images = images
         self.labels = labels
         self.n = len(images)
         self.future: Future = Future()
         self.t_enqueue = time.time()
+        self.trace = trace
 
 
 class MicroBatcher:
@@ -208,16 +229,17 @@ class MicroBatcher:
             raise ValueError(f"request of {n} images exceeds the largest "
                              f"bucket {self.engine.max_batch}")
         tel = self.telemetry
+        trace = next_trace_id()
         if tel.enabled:
-            with tel.span("serve_enqueue", n=n):
-                fut = self._enqueue(images, labels, n)
+            with tel.span("serve_enqueue", n=n, trace=trace):
+                fut = self._enqueue(images, labels, n, trace)
             with self._cond:
                 tel.gauge("queue_depth", self._pending_images)
             return fut
-        return self._enqueue(images, labels, n)
+        return self._enqueue(images, labels, n, trace)
 
-    def _enqueue(self, images, labels, n: int) -> Future:
-        req = _Request(images, labels)
+    def _enqueue(self, images, labels, n: int, trace: int) -> Future:
+        req = _Request(images, labels, trace)
         with self._cond:
             if self._worker is None or self._stop:
                 raise RuntimeError("micro-batcher is not running")
@@ -266,14 +288,24 @@ class MicroBatcher:
             try:
                 n_images = sum(r.n for r in batch)
                 bucket = smallest_bucket(self.engine.buckets, n_images)
+                traces = [r.trace for r in batch]
                 if tel.enabled:
                     with tel.span("serve_batch", requests=len(batch),
-                                  images=n_images, bucket=bucket):
+                                  images=n_images, bucket=bucket,
+                                  traces=traces):
                         images, labels = self._assemble(batch)
                 else:
                     images, labels = self._assemble(batch)
-                logits, _, _ = self.engine.infer_counts(
-                    images, labels, precision=self.precision)
+                t_svc0 = time.time()
+                if tel.enabled:
+                    # trace_ids rides only on the telemetry path: engine
+                    # stubs (tests) implement the bare 3-arg signature.
+                    logits, _, _ = self.engine.infer_counts(
+                        images, labels, precision=self.precision,
+                        trace_ids=tuple(traces))
+                else:
+                    logits, _, _ = self.engine.infer_counts(
+                        images, labels, precision=self.precision)
                 t_done = time.time()
                 off = 0
                 for r in batch:
@@ -282,7 +314,13 @@ class MicroBatcher:
                     if tel.enabled:
                         tel.gauge("serve_latency_ms",
                                   round((t_done - r.t_enqueue) * 1e3, 3),
-                                  bucket=bucket, n=r.n)
+                                  bucket=bucket, n=r.n, trace=r.trace)
+                        tel.gauge("serve_queue_wait_ms",
+                                  round((t_svc0 - r.t_enqueue) * 1e3, 3),
+                                  bucket=bucket, n=r.n, trace=r.trace)
+                        tel.gauge("serve_service_ms",
+                                  round((t_done - t_svc0) * 1e3, 3),
+                                  bucket=bucket, n=r.n, trace=r.trace)
                 if tel.enabled:
                     with self._cond:
                         tel.gauge("queue_depth", self._pending_images)

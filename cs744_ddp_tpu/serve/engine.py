@@ -225,13 +225,18 @@ class InferenceEngine:
         return padded
 
     def infer_counts(self, images: np.ndarray, labels=None, *,
-                     precision: str = "f32"):
+                     precision: str = "f32",
+                     trace_ids: Sequence[int] = ()):
         """Forward a request batch of n <= max_batch images.
 
         Returns ``(logits[n, 10] f32, loss_sum, correct)``; pad rows carry
         label -1 and contribute NOTHING to loss_sum/correct (the
         ``masked_eval_counts`` convention).  Unlabeled requests (labels
         None) get all -1 labels, so both counts are exactly 0.
+
+        ``trace_ids`` (micro-batcher, telemetry runs) are the riding
+        requests' trace ids; the dispatch/fetch spans carry them so every
+        device dispatch is attributable to the exact requests it served.
         """
         images = np.ascontiguousarray(images, np.uint8)
         n = images.shape[0]
@@ -244,10 +249,12 @@ class InferenceEngine:
         tel = self.telemetry
         if tel.enabled:
             tel.counter(f"serve_bucket_{bucket}")
-            with tel.span("serve_dispatch", bucket=bucket, n=n):
+            traces = list(trace_ids)
+            with tel.span("serve_dispatch", bucket=bucket, n=n,
+                          traces=traces):
                 logits, loss_sum, correct = ex(self.params, self.bn_state,
                                                staged, padded_labels)
-            with tel.span("serve_fetch", bucket=bucket):
+            with tel.span("serve_fetch", bucket=bucket, traces=traces):
                 out = np.asarray(logits)[:n]
                 counts = (float(loss_sum), int(correct))
         else:

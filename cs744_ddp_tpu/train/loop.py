@@ -39,7 +39,7 @@ from ..ft import (FTConfig, ChaosError, NULL_CHAOS, NonFiniteError,
                   PreemptedError, PreemptionGuard, RankDeathError)
 from ..ft import guard as ftguard
 from ..ft import supervisor as ftsup
-from ..obs import NULL, git_sha
+from ..obs import NULL, git_sha, ringbuf
 from ..ops import sgd
 from ..parallel import get_strategy, mesh as meshlib, strategies
 from ..utils.metrics import WINDOW, WindowedTimers
@@ -106,6 +106,28 @@ def _eval_batches(split: cifar10.Split, global_batch: int
         yield imgs, labs
 
 
+def emit_memory_gauges(telemetry, **attrs) -> None:
+    """Host + device memory gauges at a window/epoch boundary (round 8):
+    peak host RSS via ``resource.getrusage`` and live device bytes via
+    ``jax.live_arrays()``.  The enabled-guard lives INSIDE so call sites
+    stay one-liners; through the NULL recorder this is a single attribute
+    check — no allocation, no write (pinned by the exploding-recorder
+    test in tests/test_telemetry.py)."""
+    if not telemetry.enabled:
+        return
+    import resource
+    rss_kib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    payload = {"host_rss_peak_mib": round(rss_kib / 1024.0, 1)}
+    try:
+        live = jax.live_arrays()
+        payload["device_live_mib"] = round(
+            sum(int(getattr(a, "nbytes", 0) or 0) for a in live) / 2 ** 20, 2)
+        payload["device_live_arrays"] = len(live)
+    except Exception:          # pragma: no cover - backend without the API
+        pass
+    telemetry.gauge("memory", payload, **attrs)
+
+
 class Trainer:
     """Wires data + model + strategy + mesh into the reference's run()."""
 
@@ -122,6 +144,7 @@ class Trainer:
                  reshuffle_each_epoch: bool = False,
                  limit_train_batches: Optional[int] = None,
                  limit_eval_batches: Optional[int] = None,
+                 metrics_ring: Optional[int] = None,
                  log: Callable[[str], None] = print,
                  telemetry=NULL,
                  ft: Optional[FTConfig] = None,
@@ -244,6 +267,31 @@ class Trainer:
                         "elastic strong scaling does not support the "
                         "non-finite guard (the pinned window carries no "
                         "guarded variant)")
+        # Device-resident metric ring (obs/ringbuf.py, round 8): the
+        # windowed paths write per-step (loss, grad sqnorm, ok, step) rows
+        # into a donated on-device ring and the host drains it ONCE per
+        # window instead of fetching stacked per-step ys.  None = on by
+        # default at DEFAULT_CAPACITY; 0 disables; N sets the capacity.
+        # Forced off where it cannot apply: elastic strong scaling (the
+        # pinned world-invariant window carries no ring variant) and
+        # profile_phases (per-step dispatch is that mode's point — every
+        # step already round-trips).
+        if metrics_ring is None:
+            ring_cap = ringbuf.DEFAULT_CAPACITY
+        else:
+            ring_cap = int(metrics_ring)
+            if ring_cap < 0:
+                raise ValueError(
+                    f"metrics_ring must be >= 0, got {metrics_ring}")
+            if ring_cap and ring_cap < WINDOW:
+                raise ValueError(
+                    f"metrics_ring capacity {ring_cap} is below the scan "
+                    f"window length {WINDOW}: rows would be overwritten "
+                    f"before the per-window drain")
+        if profile_phases or (
+                elastic is not None and elastic.protocol == "strong"):
+            ring_cap = 0
+        self.metrics_ring = ring_cap
         self.preempted = False
         self._preempt_guard: Optional[PreemptionGuard] = None
         self._rollback = None            # host snapshot for policy=restore
@@ -321,6 +369,18 @@ class Trainer:
                 self.apply_fn, self.mesh, sgd_cfg,
                 microshards=elastic.microshards, augment=augment,
                 compute_dtype=compute_dtype)
+        # Ring variants of the windowed programs (built alongside, compiled
+        # lazily): same math, ys swapped for the donated device ring.  The
+        # non-ring train_window stays built either way — bench's phase
+        # split and throughput probes dispatch it directly.
+        self.train_window_ring = None
+        self.train_window_host_ring = None
+        if self.metrics_ring:
+            self.train_window_ring = steplib.make_train_window(
+                self.apply_fn, strat, self.mesh, sgd_cfg, augment=augment,
+                compute_dtype=compute_dtype, nonfinite_guard=self._guard_on,
+                nonfinite_chaos_steps=self._nf_chaos_steps,
+                metrics_ring=True)
         if host_augment:
             self.train_step_host = steplib.make_train_step(
                 self.apply_fn, strat, self.mesh, sgd_cfg, augment="host",
@@ -334,6 +394,13 @@ class Trainer:
                 self.apply_fn, strat, self.mesh, sgd_cfg, augment=False,
                 compute_dtype=compute_dtype, nonfinite_guard=self._guard_on,
                 nonfinite_chaos_steps=self._nf_chaos_steps)
+            if self.metrics_ring:
+                self.train_window_host_ring = steplib.make_train_window(
+                    self.apply_fn, strat, self.mesh, sgd_cfg, augment=False,
+                    compute_dtype=compute_dtype,
+                    nonfinite_guard=self._guard_on,
+                    nonfinite_chaos_steps=self._nf_chaos_steps,
+                    metrics_ring=True)
         self.eval_window = steplib.make_eval_window(
             self.apply_fn, self.mesh, compute_dtype=compute_dtype)
         if profile_phases:
@@ -404,6 +471,7 @@ class Trainer:
                             {"protocol": elastic.protocol,
                              "microshards": elastic.microshards}),
                 "profile_phases": profile_phases,
+                "metrics_ring": self.metrics_ring,
                 "seed": seed,
                 "reshuffle_each_epoch": reshuffle_each_epoch,
                 "real_data": self.real_data,
@@ -512,6 +580,55 @@ class Trainer:
                 "saved_mib": round(
                     max(0.0, grad_mib - stats["total_result_mib"]), 3)})
 
+    # -- metric ring (obs/ringbuf.py, round 8) ------------------------------
+
+    def _make_ring_device(self):
+        """Fresh epoch ring, committed REPLICATED to the mesh up front —
+        like ``_commit_state``, so the first ring dispatch already sees the
+        sharding every later (donated) dispatch returns: signature-stable
+        from call one."""
+        rep = meshlib.replicated(self.mesh)
+        return (meshlib.put_global(
+                    np.zeros((self.metrics_ring, ringbuf.N_METRICS),
+                             np.float32), rep),
+                meshlib.put_global(np.zeros((), np.int32), rep))
+
+    def _ring_sds(self):
+        """ShapeDtypeStructs of the ring pair, for AOT warmup lowers."""
+        rep = meshlib.replicated(self.mesh)
+        return (jax.ShapeDtypeStruct(
+                    (self.metrics_ring, ringbuf.N_METRICS), jnp.float32,
+                    sharding=rep),
+                jax.ShapeDtypeStruct((), jnp.int32, sharding=rep))
+
+    def _count_round_trip(self, site: str, **attrs) -> None:
+        """Tally one device->host value fetch.  The windowed+ring epoch is
+        pinned at <= windows + 2 of these (per-window drains, the ragged
+        tail, the eval fetch); the per-step path honestly records one per
+        iteration — the contrast the ring exists to remove."""
+        if self.telemetry.enabled:
+            self.telemetry.counter("host_round_trips", 1, site=site, **attrs)
+
+    def _consume_ring(self, buf_host, writes_total: int, w: int,
+                      per_iter: float, timers: WindowedTimers,
+                      epoch: int) -> np.ndarray:
+        """Feed one drained window into the reference-parity timers (and,
+        when telemetry is on, the JSONL step stream with reconstructed
+        absolute step indices + grad sqnorms).  Returns the ok column for
+        the non-finite policy layer.  ``buf_host`` is the already-fetched
+        buffer — the ONE round-trip happened inside the timed span."""
+        rows = ringbuf.drain_rows(buf_host, writes_total, w)
+        losses, gsq, oks, steps = ringbuf.split_columns(rows)
+        if self.telemetry.enabled:
+            for l, g, s in zip(losses, gsq, steps):
+                timers.record(float(l), per_iter,
+                              extra={"grad_sqnorm": float(g),
+                                     "step_index": int(s)})
+        else:
+            for l in losses:
+                timers.record(float(l), per_iter)
+        return oks
+
     # -- fault tolerance (ft/) ----------------------------------------------
 
     def _snapshot_rollback(self) -> None:
@@ -560,6 +677,7 @@ class Trainer:
         """Advance ``self.state`` from a per-step program result, absorbing
         the guarded arity; returns (loss, ok_or_None) as host values (the
         loss fetch is the completion fence either way)."""
+        self._count_round_trip("step_fetch")
         if self._guard_on:
             self.state, loss, ok = out
             return float(loss), bool(ok)
@@ -791,15 +909,22 @@ class Trainer:
         epoch_images, epoch_labels, _ = staged
         nbatches = epoch_images.shape[0]
         key = jax.random.PRNGKey(self.seed)
+        ring_on = self.train_window_ring is not None
         for w in self._window_shape_set(nbatches):
-            cache_key = (w, tuple(epoch_images.shape))
+            cache_key = (w, tuple(epoch_images.shape), ring_on)
             if cache_key in self._warmed_window_shapes:
                 continue
             with self.telemetry.span("compile_warmup",
                                      program="train_window", window=w):
-                self.train_window.lower(
-                    self.state, key, epoch_images, epoch_labels,
-                    jnp.int32(0), jnp.zeros((w,), jnp.int8)).compile()
+                if ring_on:
+                    self.train_window_ring.lower(
+                        self.state, self._ring_sds(), key, epoch_images,
+                        epoch_labels, jnp.int32(0),
+                        jnp.zeros((w,), jnp.int8)).compile()
+                else:
+                    self.train_window.lower(
+                        self.state, key, epoch_images, epoch_labels,
+                        jnp.int32(0), jnp.zeros((w,), jnp.int8)).compile()
             self._warmed_window_shapes.add(cache_key)
 
     def _warm_tail_step(self, tail) -> None:
@@ -875,6 +1000,9 @@ class Trainer:
         epoch_images, epoch_labels, tail = staged
         nbatches = epoch_images.shape[0]
         start = start_step
+        use_ring = self.train_window_ring is not None
+        ring = self._make_ring_device() if use_ring else None
+        ring_writes = 0
         self._check_preempt(epoch, start)
         while start < nbatches:
             # Resume windows re-align to the ABSOLUTE window grid: the
@@ -890,17 +1018,34 @@ class Trainer:
             with self.telemetry.span("train_window",
                                      strategy=self.strategy_name,
                                      start=int(start), batches=int(w)):
-                out = self.train_window(
-                    self.state, key, epoch_images, epoch_labels,
-                    jnp.int32(start), jnp.zeros((w,), jnp.int8))
-                if self._guard_on:
-                    self.state, losses, oks = out
+                if use_ring:
+                    self.state, ring = self.train_window_ring(
+                        self.state, ring, key, epoch_images, epoch_labels,
+                        jnp.int32(start), jnp.zeros((w,), jnp.int8))
+                    ring_writes += w
+                    # The window's ONE device->host round-trip: the whole
+                    # ring buffer, doubling as the completion fence.
+                    buf_host = np.asarray(ring[0])
                 else:
-                    (self.state, losses), oks = out, None
-                losses = np.asarray(losses)  # value fetch = completion fence
+                    out = self.train_window(
+                        self.state, key, epoch_images, epoch_labels,
+                        jnp.int32(start), jnp.zeros((w,), jnp.int8))
+                    if self._guard_on:
+                        self.state, losses, oks = out
+                    else:
+                        (self.state, losses), oks = out, None
+                    losses = np.asarray(losses)  # value fetch = fence
             per_iter = (time.time() - t0) / w
-            for loss in losses:
-                timers.record(float(loss), per_iter)
+            self._count_round_trip("window_drain" if use_ring
+                                   else "window_fetch", epoch=epoch)
+            if use_ring:
+                oks = self._consume_ring(buf_host, ring_writes, w, per_iter,
+                                         timers, epoch)
+                if not self._guard_on:
+                    oks = None
+            else:
+                for loss in losses:
+                    timers.record(float(loss), per_iter)
             if self._nf_chaos_steps and \
                     self.chaos.fire_range("nonfinite_grad", start, start + w):
                 self._record_chaos("nonfinite_grad", next(
@@ -909,6 +1054,7 @@ class Trainer:
             if oks is not None:
                 self._handle_nonfinite(oks, epoch)
             self._rank_boundary(epoch, start, per_iter)
+            emit_memory_gauges(self.telemetry, epoch=epoch, step=int(start))
             self._check_preempt(epoch, start)
         if tail is not None and start_step <= nbatches:
             # The ragged final batch (drop_last=False parity) through its
@@ -1522,8 +1668,9 @@ class Trainer:
         self._warm_per_step_tail_shapes()
         # Warm the window + assembly compiles so none lands inside a timed
         # window.
+        host_ring = self.train_window_host_ring is not None
         for w in self._host_window_shapes():
-            cache_key = ("host", w, self.global_batch)
+            cache_key = ("host", w, self.global_batch, host_ring)
             if cache_key not in self._warmed_window_shapes:
                 x_sds = jax.ShapeDtypeStruct(
                     (w, self.global_batch, 32, 32, 3), jnp.uint8,
@@ -1534,9 +1681,14 @@ class Trainer:
                 with self.telemetry.span("compile_warmup",
                                          program="train_window_host",
                                          window=w):
-                    self.train_window_host.lower(
-                        self.state, key, x_sds, y_sds, jnp.int32(0),
-                        jnp.zeros((w,), jnp.int8)).compile()
+                    if host_ring:
+                        self.train_window_host_ring.lower(
+                            self.state, self._ring_sds(), key, x_sds, y_sds,
+                            jnp.int32(0), jnp.zeros((w,), jnp.int8)).compile()
+                    else:
+                        self.train_window_host.lower(
+                            self.state, key, x_sds, y_sds, jnp.int32(0),
+                            jnp.zeros((w,), jnp.int8)).compile()
                 self._warmed_window_shapes.add(cache_key)
             pattern = tuple(self._chunk_plan(w))
             if len(pattern) > 1:
@@ -1557,6 +1709,8 @@ class Trainer:
                               for c in pattern]).compile()
                     self._warmed_window_shapes.add(akey)
         trained = start_step            # absolute batches applied to state
+        ring = self._make_ring_device() if host_ring else None
+        ring_writes = 0
         restarts_left = self.ft.producer_restarts if self._supervise else 0
         self._check_preempt(epoch, trained)
 
@@ -1649,17 +1803,32 @@ class Trainer:
             # exact-length window arrays (value-identical), while making
             # the scan's step indices ABSOLUTE — which is what the
             # compiled-in nonfinite-chaos masks are keyed by.
-            out = self.train_window_host(
-                self.state, key, xw, yw, jnp.int32(trained),
-                jnp.zeros((w,), jnp.int8))
-            if self._guard_on:
-                self.state, losses, oks = out
+            if host_ring:
+                self.state, ring = self.train_window_host_ring(
+                    self.state, ring, key, xw, yw, jnp.int32(trained),
+                    jnp.zeros((w,), jnp.int8))
+                ring_writes += w
+                buf_host = np.asarray(ring[0])  # one fetch = fence
             else:
-                (self.state, losses), oks = out, None
-            losses = np.asarray(losses)  # value fetch = fence
+                out = self.train_window_host(
+                    self.state, key, xw, yw, jnp.int32(trained),
+                    jnp.zeros((w,), jnp.int8))
+                if self._guard_on:
+                    self.state, losses, oks = out
+                else:
+                    (self.state, losses), oks = out, None
+                losses = np.asarray(losses)  # value fetch = fence
             per_iter = (time.time() - t0) / w
-            for loss in losses:
-                timers.record(float(loss), per_iter)
+            self._count_round_trip("window_drain" if host_ring
+                                   else "window_fetch", epoch=epoch)
+            if host_ring:
+                oks = self._consume_ring(buf_host, ring_writes, w, per_iter,
+                                         timers, epoch)
+                if not self._guard_on:
+                    oks = None
+            else:
+                for loss in losses:
+                    timers.record(float(loss), per_iter)
             if self._nf_chaos_steps and self.chaos.fire_range(
                     "nonfinite_grad", trained, trained + w):
                 self._record_chaos("nonfinite_grad", next(
@@ -1669,6 +1838,7 @@ class Trainer:
             if oks is not None:
                 self._handle_nonfinite(oks, epoch)
             self._rank_boundary(epoch, trained, per_iter)
+            emit_memory_gauges(self.telemetry, epoch=epoch, step=int(trained))
             self._check_preempt(epoch, trained)
         self.last_epoch_timers = timers
         return timers
@@ -1718,6 +1888,7 @@ class Trainer:
             loss_sum, corr = self.eval_window(self.state, images, labels)
             # Value fetches inside the span so it covers real device work.
             loss_sum, corr = float(loss_sum), int(corr)
+            self._count_round_trip("eval")
         n = len(self.test_split.labels)
         if self.limit_eval_batches is not None:
             n = min(n, self.limit_eval_batches * self.global_batch)
@@ -1969,6 +2140,7 @@ class Trainer:
                     self.telemetry.gauge("epoch_time_s", time.time() - t0,
                                          epoch=epoch)
                     self._emit_device_gauges(epoch)
+                    emit_memory_gauges(self.telemetry, epoch=epoch)
                 self.test_model()
                 if mngr is not None:
                     with self.telemetry.span("checkpoint_save", epoch=epoch):

@@ -278,13 +278,26 @@ def make_train_step(apply_fn: Callable, strategy: parallel.strategies.Strategy,
     return step
 
 
+def _ring_row(buf, cnt, loss, grads, ok, idx):
+    """Append one (loss, grad sqnorm, ok, step marker) row to the metric
+    ring inside the scanned body (obs/ringbuf.py).  The sqnorm is computed
+    on the POST-sync grads, so the write is replicated and the ring can
+    carry a replicated out-spec; the loss value is the same tensor the
+    non-ring path stacks into ys — observation only, bitwise-inert."""
+    from ..obs import ringbuf
+    gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in jax.tree.leaves(grads))
+    return ringbuf.ring_write((buf, cnt), (loss, gsq, ok, idx))
+
+
 def make_train_window(apply_fn: Callable,
                       strategy: parallel.strategies.Strategy, mesh: Mesh,
                       cfg: sgd.SGDConfig = sgd.SGDConfig(),
                       *, augment: bool = True,
                       compute_dtype=None,
                       nonfinite_guard: bool = False,
-                      nonfinite_chaos_steps=()) -> Callable:
+                      nonfinite_chaos_steps=(),
+                      metrics_ring: bool = False) -> Callable:
     """Windowed train step: W iterations per dispatch via ``lax.scan``.
 
     window(state, key, epoch_images[NB,B,32,32,3], epoch_labels[NB,B],
@@ -306,12 +319,26 @@ def make_train_window(apply_fn: Callable,
     index, so one compiled program injects at exactly the planned batches
     regardless of window boundaries.  Both default off/empty: the program
     is identical to the unguarded build.
+
+    ``metrics_ring`` swaps the per-step ys for a device-resident metric
+    ring (obs/ringbuf.py) carried through the scan and DONATED alongside
+    the state:
+
+    window(state, ring, key, epoch_images, epoch_labels, start,
+           length_arr) -> (state, ring)
+
+    The scanned body writes one (loss, grad sqnorm, ok, step) row per
+    iteration via dynamic-update-slice; the host drains the ring once per
+    window instead of fetching stacked ys — same loss values, one fetch.
     """
     chaos_steps = tuple(int(s) for s in nonfinite_chaos_steps)
 
     def scan_one(apply_fn, strategy_fn, axis_ok):
         def one(carry, xs):
-            params, bn_state, opt_state, key = carry
+            if metrics_ring:
+                params, bn_state, opt_state, key, buf, cnt = carry
+            else:
+                params, bn_state, opt_state, key = carry
             images, labels, idx = xs
             # Canonical fold order across ALL execution paths (see
             # fold_and_prepare): batch index first, mesh position second —
@@ -347,15 +374,22 @@ def make_train_window(apply_fn: Callable,
                 p, bn, opt, ok = _guarded_update(
                     params, bn_state, opt_state, grads, cfg, loss, new_bn,
                     staged_opt=staged_opt)
+                if metrics_ring:
+                    buf, cnt = _ring_row(buf, cnt, loss, grads, ok, idx)
+                    return (p, bn, opt, key, buf, cnt), None
                 return (p, bn, opt, key), (loss, ok)
             new_params, new_opt = sgd.update(params, grads, staged_opt, cfg)
+            if metrics_ring:
+                buf, cnt = _ring_row(buf, cnt, loss, grads,
+                                     jnp.float32(1.0), idx)
+                return (new_params, new_bn, new_opt, key, buf, cnt), None
             return (new_params, new_bn, new_opt, key), loss
         return one
 
     single = strategy is parallel.strategies.local
 
-    def window_body(params, bn_state, opt_state, key, epoch_images,
-                    epoch_labels, start, length_arr):
+    def _scan(params, bn_state, opt_state, key, buf, cnt, epoch_images,
+              epoch_labels, start, length_arr):
         w = length_arr.shape[0]
         imgs = lax.dynamic_slice_in_dim(epoch_images, start, w, axis=0)
         labs = lax.dynamic_slice_in_dim(epoch_labels, start, w, axis=0)
@@ -365,16 +399,43 @@ def make_train_window(apply_fn: Callable,
                        else (lambda g, c: apply_strategy(
                            strategy, g, DATA_AXIS, c)),
                        axis_ok=not single)
-        (p, bn, opt, _), ys = lax.scan(
-            one, (params, bn_state, opt_state, key), (imgs, labs, idxs))
-        if nonfinite_guard:
-            losses, oks = ys
-            return p, bn, opt, losses, oks
-        return p, bn, opt, ys
+        carry = ((params, bn_state, opt_state, key, buf, cnt)
+                 if metrics_ring else (params, bn_state, opt_state, key))
+        return lax.scan(one, carry, (imgs, labs, idxs))
+
+    if metrics_ring:
+        def window_body(params, bn_state, opt_state, key, buf, cnt,
+                        epoch_images, epoch_labels, start, length_arr):
+            (p, bn, opt, _, buf, cnt), _ = _scan(
+                params, bn_state, opt_state, key, buf, cnt, epoch_images,
+                epoch_labels, start, length_arr)
+            return p, bn, opt, buf, cnt
+    else:
+        def window_body(params, bn_state, opt_state, key, epoch_images,
+                        epoch_labels, start, length_arr):
+            (p, bn, opt, _), ys = _scan(
+                params, bn_state, opt_state, key, None, None, epoch_images,
+                epoch_labels, start, length_arr)
+            if nonfinite_guard:
+                losses, oks = ys
+                return p, bn, opt, losses, oks
+            return p, bn, opt, ys
 
     if single:
         if mesh.devices.size != 1:
             raise ValueError("'single' strategy requires a 1-device mesh")
+
+        if metrics_ring:
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def window(state: TrainState, ring, key, epoch_images,
+                       epoch_labels, start, length_arr):
+                out = window_body(
+                    state.params, state.bn_state, state.opt_state, key,
+                    ring[0], ring[1], epoch_images, epoch_labels, start,
+                    length_arr)
+                return TrainState(*out[:3]), (out[3], out[4])
+
+            return window
 
         @partial(jax.jit, donate_argnums=(0,))
         def window(state: TrainState, key, epoch_images, epoch_labels,
@@ -387,6 +448,27 @@ def make_train_window(apply_fn: Callable,
         return window
 
     opt_spec = _opt_specs(strategy)
+    if metrics_ring:
+        # The ring rows are written from replicated values (pmean'd loss,
+        # post-sync grads), so the ring stays replicated like the state.
+        mapped = shard_map(
+            window_body, mesh=mesh,
+            in_specs=(P(), P(), opt_spec, P(), P(), P(),
+                      P(None, DATA_AXIS), P(None, DATA_AXIS), P(), P()),
+            out_specs=(P(), P(), opt_spec, P(), P()),
+            **_SHARD_MAP_KW,
+        )
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def window(state: TrainState, ring, key, epoch_images, epoch_labels,
+                   start, length_arr):
+            out = mapped(state.params, state.bn_state, state.opt_state, key,
+                         ring[0], ring[1], epoch_images, epoch_labels,
+                         start, length_arr)
+            return TrainState(*out[:3]), (out[3], out[4])
+
+        return window
+
     out_specs = ((P(), P(), opt_spec, P(), P()) if nonfinite_guard
                  else (P(), P(), opt_spec, P()))
     mapped = shard_map(

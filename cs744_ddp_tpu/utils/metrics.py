@@ -48,7 +48,7 @@ class WindowedTimers:
 
     def record(self, loss: float, step_time: float,
                forward_time: Optional[float] = None, *,
-               steady: bool = True) -> None:
+               steady: bool = True, extra: Optional[dict] = None) -> None:
         """Record one iteration. ``forward_time`` is optional because the
         functional step is a single fused program; when the trainer runs the
         split-phase timing mode it supplies both phases (the reference's
@@ -59,6 +59,10 @@ class WindowedTimers:
         path's ragged tail, whose lone per-dispatch sample carries ~100 ms
         of tunnel latency that the amortized per-window samples do not
         (one outlier per epoch would skew the derived throughput).
+
+        ``extra`` merges additional fields into the telemetry step event
+        (ring-drain rows carry grad sqnorm + reconstructed step index);
+        the stdout print schedule never changes with it.
         """
         self.epoch_loss += loss
         self.losses.append(loss)
@@ -68,7 +72,7 @@ class WindowedTimers:
             self.telemetry.step(
                 epoch=self.epoch, iter=self.iter_number, loss=float(loss),
                 step_time=step_time, forward_time=forward_time,
-                steady=not warmup and steady)
+                steady=not warmup and steady, **(extra or {}))
         if forward_time is not None:
             self.forward_time += forward_time
             self.backward_time += step_time - forward_time
@@ -109,3 +113,12 @@ class Stopwatch:
     def __exit__(self, *exc):
         self.elapsed = time.time() - self.t0
         return False
+
+
+def mfu_fields(ips_per_chip: float, flops_per_image, **kw) -> dict:
+    """tflops/MFU fields for one chip's throughput.  Delegates to
+    ``analysis.costmodel.mfu_fields`` — the ONE copy of the peak constant
+    and rounding that bench.py and the attribution tooling also use, so
+    the numbers cannot drift between reports (round 8)."""
+    from ..analysis.costmodel import mfu_fields as _mfu
+    return _mfu(ips_per_chip, flops_per_image, **kw)

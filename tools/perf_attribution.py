@@ -17,13 +17,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def throughput(**kw):
+    """(img/s, MFU fields) for one variant.  MFU arithmetic lives in
+    analysis/costmodel.mfu_fields — the one copy of the v5e peak constant
+    (round 8); this tool only measures."""
+    from cs744_ddp_tpu.analysis.costmodel import mfu_fields
     from cs744_ddp_tpu.train.loop import Trainer
     defaults = dict(model="vgg11", strategy="single", num_devices=1,
                     global_batch=256, data_dir="./data", log=lambda s: None)
     defaults.update(kw)
     tr = Trainer(**defaults)
     _, ips = tr.steady_state_throughput(max_iters=100)
-    return ips
+    return ips, mfu_fields(ips, tr.step_flops_per_image())
 
 
 def main():
@@ -44,10 +48,11 @@ def main():
     ]
     for name, kw in experiments:
         t0 = time.time()
-        ips = throughput(**kw)
-        results[name] = round(ips, 1)
-        print(f"{name:22s} {ips:10.1f} img/s  (wall {time.time()-t0:.0f}s)",
-              file=sys.stderr)
+        ips, mfu = throughput(**kw)
+        results[name] = {"images_per_sec": round(ips, 1), **mfu}
+        print(f"{name:22s} {ips:10.1f} img/s  "
+              f"mfu {mfu.get('mfu_vs_bf16_peak', '-')}  "
+              f"(wall {time.time()-t0:.0f}s)", file=sys.stderr)
     print(json.dumps(results))
 
 

@@ -44,11 +44,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from cs744_ddp_tpu.analysis.costmodel import (  # noqa: E402
+    V5E_BF16_PEAK_FLOPS as V5E_PEAK_FLOPS,
+    V5E_HBM_BYTES_PER_S as V5E_HBM_BYTES)
+
 R = 3            # timed dispatches (min taken; first extra dispatch warms)
 TARGET_MS = 300  # device work per dispatch: >> the ~±10 ms dispatch jitter
-
-V5E_PEAK_FLOPS = 197e12
-V5E_HBM_BYTES = 819e9
 
 # VGG-11 conv stages at 32x32 input: (H=W, Cin, Cout); pool after stages
 # marked in POOL_AFTER (reference model.py:3-8, cfg 'VGG11').

@@ -132,6 +132,84 @@ def _audit_lines(manifest) -> list:
     return lines
 
 
+def _attribution_lines(manifest) -> list:
+    """Cost-model attribution rendering (round 8: ``--audit-zoo`` with a
+    telemetry dir records ``manifest["attribution"]`` via
+    analysis/audit.record_attribution): per-program analytic
+    FLOPs/HBM/wire with the roofline verdict, the measured MFU join when
+    present, and overlap's exposed-comm bound vs ddp.  Returns [] when
+    the manifest carries no attribution record — older runs render
+    unchanged."""
+    attr = (manifest or {}).get("attribution")
+    if not isinstance(attr, dict):
+        return []
+    lines = ["== attribution (static cost model) =="]
+    progs = attr.get("programs") or {}
+    if progs:
+        lines.append(f"  {'program':<28} {'gflops':>9} {'hbm_mib':>9} "
+                     f"{'wire_mib':>9}  bound      comm/compute")
+        for name, rec in sorted(progs.items()):
+            ratio = rec.get("comm_compute_ratio")
+            lines.append(
+                f"  {name:<28} {rec.get('gflops', 0):>9} "
+                f"{rec.get('hbm_mib', 0):>9} {rec.get('wire_mib', 0):>9}  "
+                f"{rec.get('roofline_bound', '?'):<9}  "
+                f"{ratio if ratio is not None else '-'}")
+    measured = attr.get("measured")
+    if isinstance(measured, dict):
+        lines.append(f"  measured join          {measured.get('program')}: "
+                     f"{measured.get('images_per_sec_per_chip')} img/s/chip, "
+                     f"mfu {measured.get('mfu_vs_bf16_peak')}, "
+                     f"{measured.get('roofline_bound')}-bound")
+    ov = attr.get("overlap_vs_ddp")
+    if isinstance(ov, dict):
+        lines.append(f"  overlap exposed comm   <= "
+                     f"{ov.get('overlap_exposed_bytes_upper_bound')} B vs "
+                     f"ddp chained {ov.get('ddp_chained_bytes')} B "
+                     f"(hiding ratio >= {ov.get('hiding_ratio_lower_bound')})")
+    lines.append("")
+    return lines
+
+
+def _trace_lines(events) -> list:
+    """Serving-causality rendering (round 8): per-request trace ids ride
+    the enqueue -> batch -> dispatch -> fetch spans, and two per-request
+    gauges split client latency into queue wait vs service time.  Returns
+    [] for runs with no trace signal — older runs render unchanged."""
+    trace_reqs = set()
+    dispatch_spans = 0
+    dispatch_traced = 0
+    qw, svc = [], []
+    for e in events:
+        kind, name = e.get("kind"), e.get("name")
+        if kind == "span" and name == "serve_enqueue" and "trace" in e:
+            trace_reqs.add(e["trace"])
+        elif kind == "span" and name == "serve_dispatch":
+            dispatch_spans += 1
+            if e.get("traces"):
+                dispatch_traced += 1
+        elif kind == "gauge" and name == "serve_queue_wait_ms":
+            qw.append(e["value"])
+        elif kind == "gauge" and name == "serve_service_ms":
+            svc.append(e["value"])
+    if not trace_reqs and not qw and not svc:
+        return []
+    lines = ["== traces (request causality) =="]
+    if trace_reqs:
+        lines.append(f"  traced requests        {len(trace_reqs)}")
+    if dispatch_spans:
+        lines.append(f"  dispatch spans         {dispatch_spans} "
+                     f"({dispatch_traced} carrying trace ids)")
+    for label, v in (("queue wait", qw), ("service time", svc)):
+        if v:
+            lines.append(f"  {label:<12} x{len(v):<6} "
+                         f"p50 {percentile(v, 50):8.2f} ms  "
+                         f"p95 {percentile(v, 95):8.2f} ms  "
+                         f"mean {sum(v) / len(v):8.2f} ms")
+    lines.append("")
+    return lines
+
+
 def render(out_dir: str) -> str:
     manifest, events, summary = read_run(out_dir)
     # A preempted/killed run legitimately truncates the final event line;
@@ -197,6 +275,8 @@ def render(out_dir: str) -> str:
     lines.extend(_serving_lines(events))
     lines.extend(_elastic_lines(events, manifest))
     lines.extend(_audit_lines(manifest))
+    lines.extend(_attribution_lines(manifest))
+    lines.extend(_trace_lines(events))
 
     gauges = {}
     for e in events:
