@@ -749,6 +749,269 @@ def run_serving(log, *, model: str = "vgg11", buckets=None,
     return out
 
 
+def _servenet_factory():
+    """conv(3->8)+BN+relu+pool(4x)+fc — the serving-load workload model.
+
+    The load rows offer thousands of requests/sec; the flagship vgg11
+    ladder serves ~0.7 req/s on this host (run_serving), so the load
+    sections would measure nothing but one giant queue.  Same layer kinds
+    as the real models (and as the tests' tiny_cnn — redefined here
+    because tests/ is not importable from the bench), registered under
+    ``servenet`` via the models registry like any user model."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs744_ddp_tpu.models import layers
+
+    def init_fn(key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        params = {"conv": layers.conv2d_init(k1, 3, 8, 3, dtype)}
+        params["bn"], bn_state = layers.batchnorm_init(8, dtype)
+        params["fc"] = layers.linear_init(k2, 8 * 8 * 8, 10, dtype)
+        return params, {"bn": bn_state}
+
+    def apply_fn(params, state, x, *, train):
+        y = layers.conv2d_apply(params["conv"], x)
+        y, new_bn = layers.batchnorm_apply(params["bn"], state["bn"], y,
+                                           train=train)
+        y = layers.relu(y)
+        y = layers.maxpool2x2(layers.maxpool2x2(y))  # 32 -> 8
+        y = y.reshape(y.shape[0], -1)
+        return layers.linear_apply(params["fc"], y), {"bn": new_bn}
+
+    return init_fn, apply_fn
+
+
+def run_serving_load(log, *, model: str = "servenet", buckets=None,
+                     replica_counts=(1, 2, 4, 8),
+                     burst_requests: int = 500, burst_rps: float = 8000.0,
+                     burst_slo_ms: float = 2000.0,
+                     burst_sizes=(4, 8, 8, 16), queue_images: int = 256,
+                     curve_loads=(250.0, 1000.0, 2000.0, 4000.0),
+                     curve_requests: int = 400, curve_slo_ms: float = 500.0,
+                     overload_tiers=((0, 2, 1000.0), (1, 5, 500.0),
+                                     (2, 3, 800.0)),
+                     overload_requests: int = 2400,
+                     overload_queue_images: int = 4096,
+                     matched_rps: float = 400.0,
+                     seed: int = 0, precision: str = "f32") -> dict:
+    """The serving tier under load (``serve/`` round 9): replicated
+    device-pinned engines behind the least-loaded router, driven by
+    seeded open-loop traces through the in-process client.
+
+    * ``replica_scaling`` — goodput at a FIXED SLO as replicas grow
+      1->2->4->8.  PROVENANCE: this host time-shares every replica over
+      one physical core, so device throughput CANNOT scale with replica
+      count — what scales is bounded-queue admission capacity (each
+      replica brings its own ``max_queue_images`` admission queue).  The
+      row therefore offers a burst that over-runs a single replica's
+      queue, and goodput is SLO-met completions per second of the fixed
+      ``span + SLO`` observation window (same denominator every row) —
+      the component of replica scale-out that survives the 1-core
+      constraint.  On a real mesh the same row also scales service.
+    * ``goodput_vs_offered`` — the saturation curve at the full replica
+      set: goodput tracks offered load until the shared core saturates,
+      then attainment falls and shedding/overload absorb the excess.
+    * ``overload_2x`` — 2x the measured capacity, tiered traffic:
+      priority-tier admission must hold top-tier attainment while
+      deterministic shedding is confined to the lower tiers; the
+      no-silent-drop accounting (one terminal reply per request) rides
+      in the row.
+    * ``continuous_vs_drain`` — virtual-time replay of a matched trace
+      through ``plan_continuous`` vs ``plan_drain`` (the round-7
+      MicroBatcher's coalesce-and-drain semantics) using the MEASURED
+      per-bucket service model from the live rows: continuous batching
+      must hold strictly lower p99 queue-wait at matched load.
+
+    Standalone-callable, same contract as ``run_serving``."""
+    import time as _time
+
+    import jax
+
+    from cs744_ddp_tpu import models
+    from cs744_ddp_tpu.obs import NULL, Telemetry
+    from cs744_ddp_tpu.serve import (BUCKETS, EngineReplica, LoopbackClient,
+                                     ReplicaRouter, demo, plan_continuous,
+                                     plan_drain, virtual_requests)
+
+    log = log or (lambda s: print(s, file=sys.stderr))
+    buckets = tuple(buckets) if buckets else BUCKETS
+    if model == "servenet":
+        models.register_model("servenet", _servenet_factory)
+    devices = jax.devices()
+    nmax = max(replica_counts)
+    log(f"[bench] serving_load: building {nmax} {model} replicas over "
+        f"{len(devices)} device(s)")
+    t0 = _time.time()
+    replicas = [EngineReplica(i, model=model,
+                              device=devices[i % len(devices)],
+                              buckets=buckets, precision=precision,
+                              seed=seed, cost_prior=True,
+                              max_queue_images=queue_images)
+                for i in range(nmax)]
+    for r in replicas:
+        r.startup()
+    build_s = _time.time() - t0
+    pool = demo.request_pool(seed=seed + 123)
+    out = {
+        "backend": jax.default_backend(),
+        "model": model,
+        "buckets": list(buckets),
+        "num_devices": len(devices),
+        "replicas_built": nmax,
+        "build_s": round(build_s, 3),
+        "provenance": (
+            "single-physical-core host (time-shared CPU mesh): aggregate "
+            "device throughput is conserved across replica counts, so the "
+            "replica_scaling row measures what replicas add on this host — "
+            "bounded-queue admission capacity at a fixed SLO under a burst "
+            "that over-runs one replica's queue; goodput is SLO-met "
+            "completions per second of the fixed span+SLO window.  The "
+            f"workload model is the small registered '{model}' CNN: the "
+            "flagship vgg11 ladder serves <1 req/s here (see the serving "
+            "section) and cannot exercise thousands-of-req/s traces."),
+    }
+
+    def _replay(n_replicas, trace, telemetry=None, timeout_s=60.0):
+        router = ReplicaRouter(replicas[:n_replicas], telemetry=telemetry)
+        with router:
+            client = LoopbackClient(router)
+            stats = demo.replay_load(client, trace, pool=pool, seed=seed,
+                                     drain_timeout_s=timeout_s)
+        return stats, router.stats()
+
+    def _row(stats, window_s=None):
+        ok = sum(c["ok"] for c in stats["by_tier"].values())
+        row = {
+            "offered_rps": stats["offered_rps"],
+            "goodput_rps": stats["goodput_rps"],
+            "goodput_ips": stats["goodput_ips"],
+            "attainment": stats["attainment"],
+            "shed": stats["shed"],
+            "overload": stats["overload"],
+            "replies": stats["replies"],
+            "unresolved": stats["unresolved"],
+        }
+        if window_s is not None:
+            row["goodput_rps_window"] = round(ok / window_s, 2)
+        if "queue_wait_ms" in stats:
+            row["queue_wait_ms"] = stats["queue_wait_ms"]
+        return row
+
+    # Replica scaling at a fixed SLO (burst trace; see docstring).
+    burst = demo.synthetic_load_trace(
+        burst_requests, offered_rps=burst_rps, seed=seed,
+        size_choices=burst_sizes, tiers=((0, 1, burst_slo_ms),))
+    span_s = burst[-1][0]
+    window_s = span_s + burst_slo_ms / 1e3
+    scaling = {"offered_rps": round(burst_requests / max(span_s, 1e-9), 1),
+               "slo_ms": burst_slo_ms, "window_s": round(window_s, 3),
+               "per_replica_queue_images": queue_images, "rows": {}}
+    for n in replica_counts:
+        log(f"[bench] serving_load: scaling row, {n} replica(s), "
+            f"{burst_requests} reqs @ {scaling['offered_rps']} rps, "
+            f"SLO {burst_slo_ms:g} ms")
+        stats, _rs = _replay(n, burst,
+                             timeout_s=2.0 + 3.0 * burst_slo_ms / 1e3)
+        scaling["rows"][str(n)] = _row(stats, window_s=window_s)
+    g1 = scaling["rows"][str(replica_counts[0])]["goodput_rps_window"]
+    g8 = scaling["rows"][str(nmax)]["goodput_rps_window"]
+    scaling["goodput_scale_1_to_max"] = round(g8 / max(g1, 1e-9), 2)
+    out["replica_scaling"] = scaling
+    log(f"[bench] serving_load: goodput@SLO x"
+        f"{scaling['goodput_scale_1_to_max']} from 1->{nmax} replicas")
+
+    # Goodput-vs-offered-load saturation curve at the full replica set.
+    curve = {"replicas": nmax, "slo_ms": curve_slo_ms, "points": {}}
+    for rps in curve_loads:
+        nreq = max(curve_requests, min(int(rps), 2 * curve_requests))
+        trace = demo.synthetic_load_trace(
+            nreq, offered_rps=rps, seed=seed + 1,
+            tiers=((0, 1, curve_slo_ms),))
+        log(f"[bench] serving_load: curve point {rps:g} rps ({nreq} reqs)")
+        stats, _rs = _replay(nmax, trace)
+        curve["points"][f"{rps:g}"] = _row(stats)
+    out["goodput_vs_offered"] = curve
+    cap_rps = max(p["goodput_rps"] for p in curve["points"].values())
+
+    # 2x overload, tiered: top-tier attainment holds, shedding confined
+    # to the lower tiers, every request gets a terminal reply.  The
+    # tier-0 SLO sits above the p95 of one CONTENDED dispatch (8
+    # replica threads share this host's core, so a ~60ms solo dispatch
+    # runs ~300ms under contention) — below that floor no admission
+    # policy can meet the deadline and the row measures the host, not
+    # the scheduler.  The lower-tier SLOs sit BELOW the 2x backlog's
+    # measured queue-wait tail, forcing real shed decisions; tier-0
+    # jumps the queue at every admission, so its deadline holds while
+    # the tiers beneath it absorb the overload.
+    for r in replicas:
+        r.scheduler.max_queue_images = overload_queue_images
+    over_rps = 2.0 * cap_rps
+    tel = Telemetry()   # in-memory; the slo summary rides in the row
+    for r in replicas:
+        r.scheduler.telemetry = tel
+    log(f"[bench] serving_load: overload row at {over_rps:.0f} rps "
+        f"(2x measured capacity {cap_rps:.0f} rps)")
+    trace = demo.synthetic_load_trace(overload_requests,
+                                      offered_rps=over_rps, seed=seed + 2,
+                                      tiers=overload_tiers)
+    stats, _rs = _replay(nmax, trace, telemetry=tel)
+    for r in replicas:
+        r.scheduler.telemetry = NULL
+        r.scheduler.max_queue_images = queue_images
+    shed_by_tier = {str(t): c["shed"] for t, c in stats["by_tier"].items()}
+    top = min(stats["by_tier"])
+    out["overload_2x"] = {
+        "offered_rps": stats["offered_rps"],
+        "capacity_rps": round(cap_rps, 2),
+        "tiers": [list(t) for t in overload_tiers],
+        "by_tier": {str(t): c for t, c in stats["by_tier"].items()},
+        "top_tier_attainment": stats["by_tier"][top]["attainment"],
+        "shed_by_tier": shed_by_tier,
+        "total_shed": sum(shed_by_tier.values()),
+        "sheds_confined_to_lower_tiers": (
+            shed_by_tier.get(str(top), 0) == 0
+            and sum(shed_by_tier.values()) > 0),
+        "accounting": {k: stats[k] for k in
+                       ("replies", "unresolved", "unique_traces", "traced")},
+        "queue_wait_ms": stats.get("queue_wait_ms"),
+        "telemetry_summary": tel.finalize(),
+    }
+    if out["overload_2x"]["top_tier_attainment"] < 0.95:
+        log(f"[bench] serving_load: WARNING top-tier attainment "
+            f"{out['overload_2x']['top_tier_attainment']} < 0.95 under "
+            "2x overload")
+    if out["overload_2x"]["total_shed"] == 0:
+        log("[bench] serving_load: WARNING overload row shed nothing — "
+            "the shed-confinement claim is vacuous at these SLOs")
+
+    # Continuous batching vs the drain baseline: virtual-time replay of a
+    # matched trace with the MEASURED service model (deterministic given
+    # the measured per-bucket times; no thread scheduling noise).
+    svc = replicas[0].scheduler.svc
+    vtrace = demo.synthetic_load_trace(400, offered_rps=matched_rps,
+                                       seed=seed + 3,
+                                       tiers=((0, 1, curve_slo_ms),))
+    cont = plan_continuous(virtual_requests(vtrace), buckets=buckets,
+                           predict_s=svc.predict, shed=False)
+    drain = plan_drain(virtual_requests(vtrace), buckets=buckets,
+                       predict_s=svc.predict)
+    keep = ("dispatches", "served", "p50_wait_ms", "p99_wait_ms")
+    out["continuous_vs_drain"] = {
+        "matched_rps": matched_rps,
+        "service_model_ms": {str(b): round(svc.predict(b) * 1e3, 4)
+                             for b in buckets},
+        "continuous": {k: cont[k] for k in keep},
+        "drain": {k: drain[k] for k in keep},
+        "continuous_p99_lower":
+            cont["p99_wait_ms"] < drain["p99_wait_ms"],
+    }
+    log(f"[bench] serving_load: p99 queue-wait continuous "
+        f"{cont['p99_wait_ms']} ms vs drain {drain['p99_wait_ms']} ms "
+        f"at {matched_rps:g} rps")
+    return out
+
+
 def run_elastic(log, *, headline_model: str = "vgg11", ndev=None,
                 global_batch: int = 256, data_dir: str = "./data",
                 max_iters: int = 50, microshards: int = 4) -> dict:
@@ -1079,6 +1342,7 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
               spectrum: bool = True, host_pipeline: bool = True,
               compression: bool = True,
               robustness: bool = True, serving: bool = True,
+              serving_load: bool = True,
               elastic: bool = True,
               audit: bool = True,
               attribution: bool = True,
@@ -1403,6 +1667,12 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
         result["serving"] = run_serving(log, model=headline_model,
                                         **(serving_kwargs or {}))
 
+    # Serving tier under load (round 9): replica scaling at fixed SLO,
+    # goodput-vs-offered saturation, 2x tiered overload with confined
+    # shedding, continuous-vs-drain queue-wait (cs744_ddp_tpu/serve/).
+    if serving_load:
+        result["serving_load"] = run_serving_load(log)
+
     # Elastic layer: shrink/grow resume latency, steps lost, and
     # degraded single-rank throughput (cs744_ddp_tpu/elastic/).
     if elastic:
@@ -1590,6 +1860,11 @@ def main(argv=None) -> None:
                    help="skip the serving fast-path section (bucket "
                         "throughput curve, open-loop latency, cold/warm "
                         "startup)")
+    p.add_argument("--no-serving-load", action="store_true",
+                   help="skip the serving-tier load section (replica "
+                        "scaling at fixed SLO, goodput-vs-offered curve, "
+                        "2x tiered overload with confined shedding, "
+                        "continuous-vs-drain queue-wait)")
     p.add_argument("--no-elastic", action="store_true",
                    help="skip the elastic section (shrink/grow resume "
                         "latency, steps lost, degraded single-rank "
@@ -1641,6 +1916,8 @@ def main(argv=None) -> None:
                        robustness=not (args.no_robustness
                                        or args.no_matrix),
                        serving=not (args.no_serving or args.no_matrix),
+                       serving_load=not (args.no_serving_load
+                                         or args.no_matrix),
                        elastic=not (args.no_elastic or args.no_matrix),
                        audit=not (args.no_audit or args.no_matrix),
                        attribution=not (args.no_attribution

@@ -143,7 +143,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "field is the target RANK, not a seed — "
                          "SITE:step:rank): rank_death, slow_rank; "
                          "coordinator_loss fires on recovery progress "
-                         "(requires --elastic)")
+                         "(requires --elastic). Replica-level sites (third "
+                         "field is the target REPLICA, step counts its own "
+                         "dispatches): replica_death, slow_replica "
+                         "(requires --serve-frontend)")
     ft.add_argument("--ft-put-timeout", type=float, default=30.0,
                     metavar="SECONDS",
                     help="watchdog deadline on each staged chunk device_put")
@@ -208,6 +211,27 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--serve-seed", type=int, default=0,
                     help="seed for the synthetic request trace AND the "
                          "demo model init")
+    sv.add_argument("--serve-frontend", action="store_true",
+                    help="serve mode: start --serve-replicas device-pinned "
+                         "engine replicas behind the least-loaded router "
+                         "and the socket front-end, replay the seeded "
+                         "TIERED trace over a real socket at each "
+                         "--serve-load, print goodput/SLO-attainment JSON")
+    sv.add_argument("--serve-replicas", type=int, default=1, metavar="N",
+                    help="engine replicas, one per mesh device "
+                         "(round-robin when N exceeds the device count)")
+    sv.add_argument("--serve-slo-ms", type=float, default=None,
+                    metavar="MS",
+                    help="flatten the trace to ONE tier with this SLO "
+                         "(default: the 3-tier 75/200/600 ms mixture)")
+    sv.add_argument("--serve-port", type=int, default=0, metavar="PORT",
+                    help="front-end TCP port (0 = ephemeral; the bound "
+                         "address is in the output JSON — tools/"
+                         "serve_load.py replays against it)")
+    sv.add_argument("--serve-shed", default="on", choices=["on", "off"],
+                    help="deadline-aware load shedding in the scheduler "
+                         "(off = serve everything, late replies included "
+                         "— the no-shed ablation)")
     au = p.add_argument_group(
         "static analysis (analysis/)",
         "HLO/jaxpr program audit: certify each compiled program's cost "
@@ -334,6 +358,68 @@ def elastic_main(args, telemetry) -> None:
     print("elastic report: " + json.dumps(report))
 
 
+def serve_frontend_main(args, telemetry) -> None:
+    """--serve-frontend: replicated serving tier end-to-end — N
+    device-pinned engine replicas behind the least-loaded router and the
+    socket front-end; replay the seeded tiered trace over a REAL socket
+    at each offered load, print ONE JSON line (startup + per-load
+    goodput/attainment stats)."""
+    import json
+
+    import jax
+
+    from .ft import NULL_CHAOS
+    from .serve import demo
+    from .serve.frontend import FrontendClient, ServingFrontend
+    from .serve.replica import EngineReplica
+    from .serve.router import ReplicaRouter
+
+    ft = ft_config_from_args(args)
+    chaos = ft.chaos if ft is not None else NULL_CHAOS
+    buckets = demo.parse_buckets(args.serve_buckets)
+    shed = args.serve_shed == "on"
+    devices = jax.devices()
+    replicas = [
+        EngineReplica(i, args.model, device=devices[i % len(devices)],
+                      buckets=buckets, precision=args.serve_precision,
+                      seed=args.serve_seed, telemetry=telemetry,
+                      cache_dir=args.serve_cache_dir, chaos=chaos,
+                      shed=shed)
+        for i in range(max(1, args.serve_replicas))]
+    telemetry.write_manifest({
+        "mode": "serve-frontend", "model": args.model,
+        "buckets": list(buckets), "precision": args.serve_precision,
+        "replicas": len(replicas), "shed": shed,
+        "slo_ms": args.serve_slo_ms,
+        "requests": args.serve_requests, "seed": args.serve_seed,
+        "chaos": chaos.spec() if chaos.enabled else [],
+    })
+    startup = {f"replica{r.index}": r.startup() for r in replicas}
+    tiers = demo.DEFAULT_TIERS if args.serve_slo_ms is None \
+        else ((0, 1, float(args.serve_slo_ms)),)
+    router = ReplicaRouter(replicas, telemetry=telemetry)
+    stats = {}
+    sizes = tuple(s for s in demo.SIZE_CHOICES if s <= buckets[-1])
+    address = None
+    with router:
+        frontend = ServingFrontend(router, port=args.serve_port,
+                                   telemetry=telemetry)
+        with frontend:
+            address = frontend.address
+            pool = demo.request_pool()
+            for rps in (args.serve_load or [20.0]):
+                trace = demo.synthetic_load_trace(
+                    args.serve_requests, offered_rps=rps,
+                    seed=args.serve_seed, size_choices=sizes, tiers=tiers)
+                with FrontendClient(frontend.address) as client:
+                    stats[f"{rps:g}rps"] = demo.replay_load(
+                        client, trace, pool=pool, seed=args.serve_seed)
+    if telemetry.enabled:
+        telemetry.update_manifest({"router": router.stats()})
+    print(json.dumps({"address": list(address), "startup": startup,
+                      "router": router.stats(), "load": stats}))
+
+
 def serve_main(args, telemetry) -> None:
     """--serve-demo: build the ladder, replay the seeded trace at each
     offered load, print ONE JSON line (startup report + per-load stats)."""
@@ -390,6 +476,14 @@ def main(argv=None) -> None:
     if args.audit_zoo:
         try:
             audit_main(args, telemetry)
+        finally:
+            telemetry.update_manifest(
+                {"compilation_cache": compcache.cache_stats()})
+            telemetry.finalize()
+        return
+    if args.serve_frontend:
+        try:
+            serve_frontend_main(args, telemetry)
         finally:
             telemetry.update_manifest(
                 {"compilation_cache": compcache.cache_stats()})

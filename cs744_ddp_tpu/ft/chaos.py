@@ -42,6 +42,20 @@ seed (``rank_death:step:rank``):
                          ``step``; it must re-derive membership from the
                          checkpoint metadata alone.
 
+Replica-level sites (serve/ — round 9's replicated serving tier).  The
+third spec field is the target REPLICA index and ``step`` counts that
+replica's OWN dispatches (``replica_death:dispatch:replica``):
+
+* ``replica_death``    — the replica's scheduler worker raises
+                         ``ChaosError`` at its dispatch ``step``; the
+                         router must fail over every unfinished request
+                         (in-flight and queued) to survivors — no
+                         accepted request is silently dropped;
+* ``slow_replica``     — the replica stalls ``slow_stall_s`` before its
+                         dispatch ``step`` (a straggling chip); the
+                         least-loaded router routes around it as its
+                         measured service EWMA inflates.
+
 The disabled plan is ``NULL_CHAOS`` — a stateless singleton exactly like
 the telemetry ``NULL`` recorder: ``enabled`` is False, ``fire*`` return
 False without allocating, and hot call sites guard on ``.enabled`` so the
@@ -55,10 +69,13 @@ from typing import List, Optional, Sequence, Tuple
 
 SITES = ("producer_crash", "put_delay", "put_fail", "corrupt_slot",
          "nonfinite_grad", "preempt", "rank_death", "slow_rank",
-         "coordinator_loss")
+         "coordinator_loss", "replica_death", "slow_replica")
 # Sites whose third spec field names the target RANK (elastic/), not a
 # payload seed — same wire format, different interpretation.
 RANK_SITES = ("rank_death", "slow_rank")
+# Sites whose third spec field names the target serving REPLICA and whose
+# step counts that replica's own dispatches (serve/replica.py).
+REPLICA_SITES = ("replica_death", "slow_replica")
 
 
 class ChaosError(RuntimeError):

@@ -296,8 +296,10 @@ class Telemetry:
             if os.path.exists(src):
                 os.replace(src, f"{base}.{k + 1}{ext}")
         os.replace(self._events_path, f"{base}.1{ext}")
-        self._fh = open(self._events_path, "a", buffering=1)
-        self._event_bytes = 0
+        # Caller holds _lock (the event() hot path), per the docstring.
+        self._fh = open(self._events_path,  # lint: ok(lock-ownership)
+                        "a", buffering=1)
+        self._event_bytes = 0               # lint: ok(lock-ownership)
 
     def step(self, *, epoch: int, iter: int, loss: float, step_time: float,
              forward_time: Optional[float] = None, steady: bool = True,
@@ -451,6 +453,43 @@ def summarize_events(events: List[Dict[str, Any]],
             "queue_wait_ms": _pct(qw),
             "service_ms": _pct(svc),
         }
+    # SLO attainment by tier (round 9): the scheduler's per-request
+    # ``serve_latency_ms`` gauges carry ``tier``/``met`` attrs and its
+    # shed decisions are ``serve_shed`` counter events with
+    # ``tier``/``reason`` — aggregated so the report's ``== slo ==``
+    # section reads only the summary.
+    slo_tiers: Dict[str, Dict[str, int]] = {}
+    for e in events:
+        if e.get("kind") == "gauge" and e.get("name") == "serve_latency_ms" \
+                and "met" in e and "tier" in e:
+            agg = slo_tiers.setdefault(str(e["tier"]),
+                                       {"served": 0, "met": 0, "shed": 0})
+            agg["served"] += 1
+            agg["met"] += 1 if e["met"] else 0
+    shed_reasons: Dict[str, int] = {}
+    for e in events:
+        if e.get("kind") == "counter" and e.get("name") == "serve_shed":
+            if "tier" in e:
+                agg = slo_tiers.setdefault(str(e["tier"]),
+                                           {"served": 0, "met": 0, "shed": 0})
+                agg["shed"] += int(e.get("inc", 1))
+            reason = str(e.get("reason", "unknown"))
+            shed_reasons[reason] = shed_reasons.get(reason, 0) \
+                + int(e.get("inc", 1))
+    if slo_tiers:
+        for agg in slo_tiers.values():
+            offered = agg["served"] + agg["shed"]
+            agg["late"] = agg["served"] - agg["met"]
+            agg["attainment"] = round(agg["met"] / offered, 4) \
+                if offered else None
+        replica_util = {
+            str(e["replica"]): e["value"] for e in events
+            if e.get("kind") == "gauge" and e.get("name") == "replica_util"
+            and "replica" in e}
+        summary["slo"] = {"by_tier": slo_tiers,
+                          "shed_by_reason": shed_reasons}
+        if replica_util:
+            summary["slo"]["replica_util"] = replica_util
     if steps:
         summary["final_loss"] = steps[-1]["loss"]
         summary["mean_loss"] = sum(s["loss"] for s in steps) / len(steps)

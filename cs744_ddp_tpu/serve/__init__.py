@@ -8,15 +8,32 @@ that coalesces concurrent requests into the largest ready bucket
 arena (``ingest``), a warm-start executable cache so a restarted server
 skips XLA compile (``cache``), and a seeded open-loop demo/measurement
 driver (``demo``).
+
+Round 9 grows this into a serving TIER: a continuous-batching SLO
+scheduler with priority-tiered admission and deterministic load shedding
+(``scheduler``), device-pinned engine replicas with chaos hooks
+(``replica``) behind a least-loaded router with death failover
+(``router``), and a socket front-end speaking a length-prefixed binary
+protocol (``frontend``).
 """
 
 from .batcher import MicroBatcher, QueueFull, coalesce, plan_batches
 from .cache import ExecutableCache, executable_serialization_supported
 from .engine import BUCKETS, InferenceEngine
+from .frontend import FrontendClient, LoopbackClient, ServingFrontend
 from .ingest import StagedIngest
+from .replica import EngineReplica
+from .router import ReplicaRouter
+from .scheduler import (Reply, SchedRequest, ServiceModel, SLOScheduler,
+                        admit, cost_model_weights, make_request,
+                        plan_continuous, plan_drain, virtual_requests)
 
 __all__ = [
-    "BUCKETS", "ExecutableCache", "InferenceEngine", "MicroBatcher",
-    "QueueFull", "StagedIngest", "coalesce",
-    "executable_serialization_supported", "plan_batches",
+    "BUCKETS", "EngineReplica", "ExecutableCache", "FrontendClient",
+    "InferenceEngine", "LoopbackClient", "MicroBatcher", "QueueFull",
+    "Reply", "ReplicaRouter", "SLOScheduler", "SchedRequest",
+    "ServiceModel", "ServingFrontend", "StagedIngest", "admit", "coalesce",
+    "cost_model_weights", "executable_serialization_supported",
+    "make_request", "plan_batches", "plan_continuous", "plan_drain",
+    "virtual_requests",
 ]

@@ -50,7 +50,18 @@ from ..obs import NULL
 
 
 class QueueFull(RuntimeError):
-    """The bounded request queue is at capacity; shed load upstream."""
+    """The bounded request queue is at capacity; shed load upstream.
+
+    ``retry_after_ms`` is the backpressure hint: the estimated time for
+    the backlog to drain enough to admit the rejected request (queue
+    depth x measured service-time EWMA).  The socket front-end forwards
+    it verbatim in the wire protocol's overload reply, so clients can
+    back off by measurement instead of by guess.
+    """
+
+    def __init__(self, msg: str, retry_after_ms: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_ms = float(retry_after_ms)
 
 
 def coalesce(sizes: Sequence[int], max_batch: int) -> Tuple[int, int]:
@@ -169,6 +180,7 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._stop = False
         self._worker: Optional[threading.Thread] = None
+        self._svc_ewma_s: Optional[float] = None   # measured dispatch EWMA
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -246,12 +258,23 @@ class MicroBatcher:
             if self._pending_images + n > self.max_queue_images:
                 raise QueueFull(
                     f"queue holds {self._pending_images} images; adding "
-                    f"{n} would exceed the {self.max_queue_images} bound")
+                    f"{n} would exceed the {self.max_queue_images} bound",
+                    retry_after_ms=self._retry_after_ms_locked(n))
             self._assert_owned()
             self._pending.append(req)
             self._pending_images += n
             self._cond.notify_all()
         return req.future
+
+    def _retry_after_ms_locked(self, n: int) -> float:
+        """Backpressure hint for a rejected request: time for the backlog
+        to drain enough to admit ``n`` more images, at one max-bucket
+        dispatch per measured service-time EWMA (a conservative 10 ms
+        prior before the first dispatch).  Caller holds ``self._cond``."""
+        svc = self._svc_ewma_s if self._svc_ewma_s is not None else 0.010
+        max_b = self.engine.max_batch
+        need = self._pending_images + n - self.max_queue_images
+        return round(1e3 * svc * max(1.0, need / float(max_b)), 3)
 
     # -- worker side --------------------------------------------------------
 
@@ -307,6 +330,10 @@ class MicroBatcher:
                     logits, _, _ = self.engine.infer_counts(
                         images, labels, precision=self.precision)
                 t_done = time.time()
+                with self._cond:
+                    prev = self._svc_ewma_s
+                    self._svc_ewma_s = (t_done - t_svc0) if prev is None \
+                        else 0.7 * prev + 0.3 * (t_done - t_svc0)
                 off = 0
                 for r in batch:
                     r.future.set_result(logits[off:off + r.n])

@@ -55,6 +55,105 @@ def synthetic_trace(n_requests: int, *, offered_rps: float, seed: int,
     return [(float(t), int(s)) for t, s in zip(times, sizes)]
 
 
+# Priority tiers for the serving-load traces: (tier, weight, slo_ms).
+# Tier 0 is interactive (tight SLO, small share), tier 2 is background
+# bulk (loose SLO) — the mix Clipper-style shedding is judged against.
+DEFAULT_TIERS = ((0, 2, 75.0), (1, 5, 200.0), (2, 3, 600.0))
+
+
+def synthetic_load_trace(n_requests: int, *, offered_rps: float, seed: int,
+                         size_choices: Sequence[int] = SIZE_CHOICES,
+                         tiers=DEFAULT_TIERS
+                         ) -> List[Tuple[float, int, int, float]]:
+    """Seeded tiered open-loop trace ``[(t_s, n_images, tier, slo_ms),...]``
+    — ``synthetic_trace`` arrivals with priority tiers drawn from the
+    weighted ``tiers`` mixture.  Deterministic in (seed, offered_rps)."""
+    base = synthetic_trace(n_requests, offered_rps=offered_rps, seed=seed,
+                           size_choices=size_choices)
+    rng = np.random.default_rng(seed + 17)
+    weights = np.asarray([w for _, w, _ in tiers], np.float64)
+    picks = rng.choice(len(tiers), size=n_requests, p=weights / weights.sum())
+    return [(t, n, int(tiers[k][0]), float(tiers[k][2]))
+            for (t, n), k in zip(base, picks)]
+
+
+def replay_load(client, trace, *, pool: Optional[cifar10.Split] = None,
+                seed: int = 0, drain_timeout_s: float = 120.0) -> dict:
+    """Open-loop replay of a tiered load trace against a serving client
+    (``LoopbackClient`` or ``FrontendClient`` — anything whose
+    ``submit(images, tier=, slo_ms=)`` returns a Future of a reply dict).
+
+    Every submitted request is awaited to a terminal reply — the
+    accounting fields (``replies`` == ``n_requests``, ``unresolved`` == 0,
+    unique trace ids) are the no-silent-drop CI pin.  Goodput counts only
+    requests served WITHIN their SLO (status ``ok``)."""
+    pool = pool if pool is not None else request_pool()
+    rng = np.random.default_rng(seed + 1)
+    batches = [pool.images[rng.integers(0, len(pool.images), size=n)]
+               for (_t, n, _tier, _slo) in trace]
+    entries = []
+    driver_lag_max = 0.0
+    t0 = time.time()
+    for (t_arr, n, tier, slo_ms), imgs in zip(trace, batches):
+        delay = t0 + t_arr - time.time()
+        if delay > 0:
+            time.sleep(delay)
+        else:
+            driver_lag_max = max(driver_lag_max, -delay)
+        fut = client.submit(imgs, tier=tier, slo_ms=slo_ms)
+        entries.append((tier, n, fut))
+    hard_deadline = time.time() + drain_timeout_s
+    replies = []
+    unresolved = 0
+    for tier, n, fut in entries:
+        try:
+            rep = fut.result(timeout=max(0.1, hard_deadline - time.time()))
+        except Exception:
+            rep, unresolved = None, unresolved + 1
+        replies.append((tier, n, rep))
+    t_end = time.time()
+
+    tiers_seen = sorted({tier for tier, _n, _r in replies})
+    by_tier = {}
+    for t in tiers_seen:
+        mine = [(n, r) for tier, n, r in replies if tier == t]
+        counts = {"offered": len(mine)}
+        for status in ("ok", "late", "shed", "overload", "error"):
+            counts[status] = sum(1 for _n, r in mine
+                                 if r is not None and r["status"] == status)
+        counts["attainment"] = round(counts["ok"] / counts["offered"], 4)
+        by_tier[t] = counts
+    ok = [(tier, n, r) for tier, n, r in replies
+          if r is not None and r["status"] == "ok"]
+    waits = sorted(r["queue_wait_ms"] for _t, _n, r in ok)
+    traces = [r["trace"] for _t, _n, r in replies
+              if r is not None and r.get("trace")]
+    span = trace[-1][0] if trace else 0.0
+    wall = max(t_end - t0, 1e-9)
+    out = {
+        "n_requests": len(trace),
+        "offered_rps": round(len(trace) / max(span, 1e-9), 2),
+        "wall_s": round(wall, 3),
+        "goodput_rps": round(len(ok) / wall, 2),
+        "goodput_ips": round(sum(n for _t, n, _r in ok) / wall, 2),
+        "attainment": round(len(ok) / len(trace), 4) if trace else None,
+        "by_tier": by_tier,
+        "shed": sum(c["shed"] for c in by_tier.values()),
+        "overload": sum(c["overload"] for c in by_tier.values()),
+        "driver_lag_ms_max": round(driver_lag_max * 1e3, 3),
+        # No-silent-drop accounting: one terminal reply per submit, and
+        # the served/shed replies carry process-unique trace ids.
+        "replies": len(replies) - unresolved,
+        "unresolved": unresolved,
+        "unique_traces": len(set(traces)),
+        "traced": len(traces),
+    }
+    if waits:
+        out["queue_wait_ms"] = {"p50": round(percentile(waits, 50), 3),
+                                "p99": round(percentile(waits, 99), 3)}
+    return out
+
+
 def run_demo(engine: InferenceEngine, *, n_requests: int = 200,
              offered_rps: float = 20.0, seed: int = 0,
              max_wait_ms: float = 5.0, max_queue_images: int = 1024,

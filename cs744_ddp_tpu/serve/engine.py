@@ -56,7 +56,8 @@ class InferenceEngine:
                  state=None, seed: int = 0, telemetry=NULL,
                  cache_dir: Optional[str] = None,
                  use_staging: bool = True,
-                 enable_compilation_cache: bool = True):
+                 enable_compilation_cache: bool = True,
+                 device=None):
         import jax
         import jax.numpy as jnp
 
@@ -87,10 +88,17 @@ class InferenceEngine:
             state = init_train_state(init_fn, jax.random.PRNGKey(seed))
         self.params = state.params
         self.bn_state = state.bn_state
+        # Replica pinning: with an explicit device, weights live there and
+        # every lowering bakes a SingleDeviceSharding for it, so N replicas
+        # occupy N distinct mesh devices instead of piling onto device 0.
+        self.device = device
+        if device is not None:
+            self.params = jax.device_put(self.params, device)
+            self.bn_state = jax.device_put(self.bn_state, device)
         self._cache = ExecutableCache(cache_dir)
         self._exec: Dict[Tuple[int, str], Any] = {}
-        self._ingest = (StagedIngest(max(self.buckets)) if use_staging
-                        else None)
+        self._ingest = (StagedIngest(max(self.buckets), device=device)
+                        if use_staging else None)
         self._jax = jax
 
         def make_forward(compute_dtype):
@@ -108,7 +116,7 @@ class InferenceEngine:
         # Everything an executable's identity depends on beyond the bucket
         # and dtype: the abstract model signature (param/bn shapes+dtypes,
         # not values) and the toolchain/device identity.
-        d0 = jax.devices()[0]
+        d0 = device if device is not None else jax.devices()[0]
         leaves, treedef = jax.tree_util.tree_flatten(
             (self.params, self.bn_state))
         self._key_fields = {
@@ -118,6 +126,7 @@ class InferenceEngine:
             "jax": jax.__version__,
             "backend": jax.default_backend(),
             "device_kind": getattr(d0, "device_kind", str(d0)),
+            "device_id": int(getattr(d0, "id", 0)),
         }
 
     # -- ladder -------------------------------------------------------------
@@ -140,6 +149,16 @@ class InferenceEngine:
     def _abstract_args(self, bucket: int):
         import jax
         import jax.numpy as jnp
+        if self.device is not None:
+            from jax.sharding import SingleDeviceSharding
+            sh = SingleDeviceSharding(self.device)
+            to_s = lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                                  sharding=sh)
+            return (jax.tree_util.tree_map(to_s, self.params),
+                    jax.tree_util.tree_map(to_s, self.bn_state),
+                    jax.ShapeDtypeStruct((bucket, 32, 32, 3), jnp.uint8,
+                                         sharding=sh),
+                    jax.ShapeDtypeStruct((bucket,), jnp.int32, sharding=sh))
         to_s = lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype)
         return (jax.tree_util.tree_map(to_s, self.params),
                 jax.tree_util.tree_map(to_s, self.bn_state),

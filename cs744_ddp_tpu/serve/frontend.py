@@ -1,0 +1,435 @@
+"""Socket front-end: length-prefixed binary protocol over TCP.
+
+Wire format (all little-endian, u32 frame-length prefix per message):
+
+* request  = ``<IBBdH`` header (req_id u32, msg u8 = 1, tier u8,
+  slo_ms f64 — <= 0 means no deadline, n u16) + n x 3072 raw u8 bytes
+  (n CIFAR images, HWC 32x32x3).
+* reply    = ``<IBBQdddH`` header (req_id u32, status u8, reason u8,
+  trace u64, retry_after_ms f64, queue_wait_ms f64, service_ms f64,
+  n u16) + n x 10 f32 logits when status is ok/late.
+
+Statuses: 0 ok, 1 late (served past deadline), 2 shed, 3 overload
+(rejected at admission — ``retry_after_ms`` carries the micro-batcher's
+backpressure hint, the satellite fix), 4 error.  Every request gets
+exactly one reply; replies are written as each Future resolves, so they
+can return OUT OF ORDER — clients match on ``req_id``.
+
+``ServingFrontend`` serves any backend exposing
+``submit(images, labels=None, *, tier, slo_ms) -> Future[Reply]`` and
+raising ``QueueFull`` — an ``SLOScheduler``, a ``ReplicaRouter``, or a
+stub.  ``FrontendClient`` (socket) and ``LoopbackClient`` (in-process,
+same reply dicts) are the two client shapes tests/bench drive.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs import NULL
+from .batcher import QueueFull
+
+IMAGE_BYTES = 32 * 32 * 3
+MSG_INFER = 1
+
+_LEN = struct.Struct("<I")
+_REQ = struct.Struct("<IBBdH")
+_REP = struct.Struct("<IBBQdddH")
+
+STATUS_CODES = {"ok": 0, "late": 1, "shed": 2, "overload": 3, "error": 4}
+STATUS_NAMES = {v: k for k, v in STATUS_CODES.items()}
+REASON_CODES = {"": 0, "deadline": 1, "predicted_miss": 2, "queue_full": 3,
+                "internal": 4}
+REASON_NAMES = {v: k for k, v in REASON_CODES.items()}
+
+MAX_FRAME = _REQ.size + 65535 * IMAGE_BYTES
+
+
+# -- codec ------------------------------------------------------------------
+
+
+def encode_request(req_id: int, images: np.ndarray, *, tier: int = 0,
+                   slo_ms: Optional[float] = None) -> bytes:
+    images = np.ascontiguousarray(images, np.uint8)
+    n = int(images.shape[0])
+    if not 0 < n <= 65535:
+        raise ValueError(f"bad request size {n}")
+    slo = -1.0 if slo_ms is None else float(slo_ms)
+    return _REQ.pack(req_id & 0xFFFFFFFF, MSG_INFER, int(tier) & 0xFF,
+                     slo, n) + images.tobytes()
+
+
+def decode_request(payload: bytes
+                   ) -> Tuple[int, np.ndarray, int, Optional[float]]:
+    if len(payload) < _REQ.size:
+        raise ValueError(f"short request frame ({len(payload)} B)")
+    req_id, msg, tier, slo, n = _REQ.unpack_from(payload)
+    if msg != MSG_INFER:
+        raise ValueError(f"unknown message type {msg}")
+    body = payload[_REQ.size:]
+    if len(body) != n * IMAGE_BYTES:
+        raise ValueError(f"request body {len(body)} B != {n} images")
+    images = np.frombuffer(body, np.uint8).reshape(n, 32, 32, 3)
+    return req_id, images, tier, (None if slo <= 0 else slo)
+
+
+def encode_reply(req_id: int, reply) -> bytes:
+    """``reply`` is a ``scheduler.Reply`` or an equivalent dict."""
+    get = reply.get if isinstance(reply, dict) else \
+        lambda k, d=None: getattr(reply, k, d)
+    status = STATUS_CODES[get("status")]
+    logits = get("logits")
+    blob = b""
+    n = 0
+    if logits is not None and status in (0, 1):
+        logits = np.ascontiguousarray(logits, np.float32)
+        n = int(logits.shape[0])
+        blob = logits.tobytes()
+    reason = get("reason") or ""
+    rcode = REASON_CODES.get(reason.split(":")[0],
+                             REASON_CODES["internal"] if reason else 0)
+    return _REP.pack(req_id & 0xFFFFFFFF, status, rcode,
+                     int(get("trace") or 0), float(get("retry_after_ms") or 0.0),
+                     float(get("queue_wait_ms") or 0.0),
+                     float(get("service_ms") or 0.0), n) + blob
+
+
+def decode_reply(payload: bytes) -> dict:
+    if len(payload) < _REP.size:
+        raise ValueError(f"short reply frame ({len(payload)} B)")
+    req_id, status, rcode, trace, retry, qw, svc, n = \
+        _REP.unpack_from(payload)
+    body = payload[_REP.size:]
+    logits = None
+    if n:
+        if len(body) != n * 40:
+            raise ValueError(f"reply body {len(body)} B != {n} rows")
+        logits = np.frombuffer(body, np.float32).reshape(n, 10).copy()
+    return {"req_id": req_id, "status": STATUS_NAMES.get(status, "error"),
+            "reason": REASON_NAMES.get(rcode, "internal"), "trace": trace,
+            "retry_after_ms": retry, "queue_wait_ms": qw, "service_ms": svc,
+            "logits": logits}
+
+
+def reply_to_dict(reply) -> dict:
+    """Normalize a ``scheduler.Reply`` to the client-side reply dict."""
+    return {"req_id": None, "status": reply.status, "reason": reply.reason,
+            "trace": reply.trace, "retry_after_ms": reply.retry_after_ms,
+            "queue_wait_ms": reply.queue_wait_ms,
+            "service_ms": reply.service_ms, "logits": reply.logits}
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def read_frame(sock: socket.socket) -> Optional[bytes]:
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (length,) = _LEN.unpack(head)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame of {length} B exceeds {MAX_FRAME}")
+    return _recv_exact(sock, length)
+
+
+def write_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+# -- server -----------------------------------------------------------------
+
+
+class ServingFrontend:
+    """Threaded acceptor feeding the admission queue.
+
+    One thread per connection; replies are written from Future
+    done-callbacks under a per-connection send lock (the scheduler's
+    worker resolves Futures out of admission order).  ``QueueFull`` at
+    admission becomes an overload reply carrying the backpressure
+    retry-after hint; any other admission failure becomes an explicit
+    error reply — the no-silent-drop contract extends to the wire.
+    """
+
+    _lock_owned = ("_conns", "_threads", "_running")
+
+    def __init__(self, backend, *, host: str = "127.0.0.1", port: int = 0,
+                 telemetry=None):
+        self.backend = backend
+        self.telemetry = telemetry if telemetry is not None else NULL
+        self._host = host
+        self._port = port
+        self._listener: Optional[socket.socket] = None
+        self._acceptor: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._conns: List[socket.socket] = []
+        self._threads: List[threading.Thread] = []
+        self._running = False
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        if self._listener is None:
+            raise RuntimeError("frontend not started")
+        return self._listener.getsockname()[:2]
+
+    def start(self) -> "ServingFrontend":
+        if self._listener is not None:
+            raise RuntimeError("frontend already started")
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((self._host, self._port))
+        ls.listen(64)
+        self._listener = ls
+        with self._lock:
+            self._running = True
+        self._acceptor = threading.Thread(target=self._accept_loop,
+                                          name="serve-accept", daemon=True)
+        self._acceptor.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            self._running = False
+            conns = list(self._conns)
+            threads = list(self._threads)
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._acceptor is not None:
+            self._acceptor.join(timeout=5.0)
+            self._acceptor = None
+        for t in threads:
+            t.join(timeout=5.0)
+        with self._lock:
+            self._conns = []
+            self._threads = []
+        self._listener = None
+
+    def __enter__(self) -> "ServingFrontend":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return           # listener closed by stop()
+            with self._lock:
+                if not self._running:
+                    conn.close()
+                    return
+                t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                     name="serve-conn", daemon=True)
+                self._conns.append(conn)
+                self._threads.append(t)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        tel = self.telemetry
+        send_lock = threading.Lock()
+        try:
+            while True:
+                try:
+                    payload = read_frame(conn)
+                except (OSError, ValueError):
+                    return
+                if payload is None:
+                    return
+                try:
+                    req_id, images, tier, slo_ms = decode_request(payload)
+                except ValueError:
+                    return       # malformed frame: drop the connection
+                try:
+                    fut = self.backend.submit(images, tier=tier,
+                                              slo_ms=slo_ms)
+                except QueueFull as e:
+                    if tel.enabled:
+                        tel.counter("frontend_overload", tier=tier)
+                    self._send(conn, send_lock, encode_reply(req_id, {
+                        "status": "overload", "reason": "queue_full",
+                        "retry_after_ms": getattr(e, "retry_after_ms", 0.0),
+                    }))
+                    continue
+                except (RuntimeError, ValueError) as e:
+                    self._send(conn, send_lock, encode_reply(req_id, {
+                        "status": "error", "reason": "internal",
+                    }))
+                    del e
+                    continue
+                if tel.enabled:
+                    tel.counter("frontend_accepted", tier=tier)
+                fut.add_done_callback(
+                    lambda f, rid=req_id, lk=send_lock, c=conn:
+                    self._on_reply(c, lk, rid, f))
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _on_reply(self, conn, send_lock, req_id: int, fut) -> None:
+        try:
+            reply = fut.result()
+        except Exception:
+            reply = {"status": "error", "reason": "internal"}
+        self._send(conn, send_lock, encode_reply(req_id, reply))
+
+    @staticmethod
+    def _send(conn, send_lock, payload: bytes) -> None:
+        try:
+            with send_lock:
+                write_frame(conn, payload)
+        except OSError:
+            pass                 # client went away; reply is undeliverable
+
+
+# -- clients ----------------------------------------------------------------
+
+
+class FrontendClient:
+    """Socket client: pipelined submits, replies matched by ``req_id``
+    from a reader thread; each submit returns a Future of a reply dict."""
+
+    _lock_owned = ("_futs", "_next_id")
+
+    def __init__(self, address: Tuple[str, int], *, timeout: float = 60.0):
+        self.timeout = timeout
+        self._sock = socket.create_connection(address, timeout=timeout)
+        self._lock = threading.Lock()
+        self._futs: Dict[int, Future] = {}
+        self._next_id = 1
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="serve-client", daemon=True)
+        self._reader.start()
+
+    def submit(self, images, *, tier: int = 0,
+               slo_ms: Optional[float] = None) -> Future:
+        fut = Future()
+        with self._lock:
+            req_id = self._next_id
+            self._next_id += 1
+            self._futs[req_id] = fut
+        try:
+            write_frame(self._sock, encode_request(req_id, images,
+                                                   tier=tier, slo_ms=slo_ms))
+        except OSError as e:
+            with self._lock:
+                self._futs.pop(req_id, None)
+            raise ConnectionError(f"frontend connection lost: {e}") from e
+        return fut
+
+    def request(self, images, *, tier: int = 0,
+                slo_ms: Optional[float] = None) -> dict:
+        return self.submit(images, tier=tier, slo_ms=slo_ms) \
+            .result(timeout=self.timeout)
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                payload = read_frame(self._sock)
+            except (OSError, ValueError):
+                payload = None
+            if payload is None:
+                break
+            try:
+                reply = decode_reply(payload)
+            except ValueError:
+                break
+            with self._lock:
+                fut = self._futs.pop(reply["req_id"], None)
+            if fut is not None and not fut.done():
+                fut.set_result(reply)
+        with self._lock:
+            dangling = list(self._futs.values())
+            self._futs = {}
+        for fut in dangling:
+            if not fut.done():
+                fut.set_result({"req_id": None, "status": "error",
+                                "reason": "internal", "trace": 0,
+                                "retry_after_ms": 0.0, "queue_wait_ms": 0.0,
+                                "service_ms": 0.0, "logits": None})
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._reader.join(timeout=5.0)
+
+    def __enter__(self) -> "FrontendClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class LoopbackClient:
+    """In-process client with the same submit/reply-dict surface as
+    ``FrontendClient`` — what bench and the demo replay drive when no
+    socket is wanted.  Overload is returned as a reply dict (like the
+    wire does), not raised."""
+
+    def __init__(self, backend):
+        self.backend = backend
+
+    def submit(self, images, *, tier: int = 0,
+               slo_ms: Optional[float] = None) -> Future:
+        try:
+            fut = self.backend.submit(images, tier=tier, slo_ms=slo_ms)
+        except QueueFull as e:
+            done = Future()
+            done.set_result({"req_id": None, "status": "overload",
+                             "reason": "queue_full", "trace": 0,
+                             "retry_after_ms": getattr(e, "retry_after_ms",
+                                                       0.0),
+                             "queue_wait_ms": 0.0, "service_ms": 0.0,
+                             "logits": None})
+            return done
+        except (RuntimeError, ValueError) as e:
+            done = Future()
+            done.set_result({"req_id": None, "status": "error",
+                             "reason": f"internal: {e}", "trace": 0,
+                             "retry_after_ms": 0.0, "queue_wait_ms": 0.0,
+                             "service_ms": 0.0, "logits": None})
+            return done
+        out = Future()
+        fut.add_done_callback(
+            lambda f: out.set_result(reply_to_dict(f.result())))
+        return out
+
+    def request(self, images, *, tier: int = 0,
+                slo_ms: Optional[float] = None) -> dict:
+        return self.submit(images, tier=tier, slo_ms=slo_ms).result()
+
+    def close(self) -> None:
+        pass

@@ -26,10 +26,11 @@ from ..data import native
 class StagedIngest:
     """Bounded double-buffered uint8 staging onto the default device."""
 
-    def __init__(self, max_batch: int, nslots: int = 2):
+    def __init__(self, max_batch: int, nslots: int = 2, device=None):
         self._max_batch = max_batch
         self._arena = native.StagingArena(nslots, 1, max_batch)
         self._put_copies = None   # aliasing probe result, resolved lazily
+        self._device = device     # None -> default device (single-engine)
 
     @property
     def nslots(self) -> int:
@@ -41,7 +42,7 @@ class StagedIngest:
         backend + alignment, so it is probed, not assumed.)"""
         import jax
         before = int(buf.flat[0])
-        x = jax.device_put(buf)
+        x = jax.device_put(buf, self._device)
         jax.block_until_ready(x)
         buf.flat[0] = np.uint8(before ^ 0xFF)
         aliased = int(np.asarray(jax.device_get(x)).flat[0]) != before
@@ -68,6 +69,7 @@ class StagedIngest:
         if n < bucket:
             row[n:bucket] = 0
         src = row[:bucket]
-        handle = jax.device_put(src.copy() if self._put_copies else src)
+        handle = jax.device_put(src.copy() if self._put_copies else src,
+                                self._device)
         self._arena.retire(slot, handle)
         return handle

@@ -1,0 +1,100 @@
+"""One serving replica: a device-pinned engine + its SLO scheduler.
+
+Replica scale-out on the mesh: each replica owns an ``InferenceEngine``
+compiled FOR one device (``SingleDeviceSharding`` baked into the AOT
+lowerings, weights resident on that device) plus a ``ServiceModel`` and
+an ``SLOScheduler`` worker thread.  Replicas are independent — no shared
+queue, no shared executables — so the router can treat them as
+interchangeable chips, and one replica dying (the ``replica_death``
+chaos site) takes down exactly its own worker.
+
+Chaos wiring: the scheduler's ``dispatch_hook`` fires this replica's
+sites against its OWN dispatch counter — ``slow_replica:STEP:REPLICA``
+stalls dispatch STEP by ``slow_stall_s`` (a straggler), and
+``replica_death:STEP:REPLICA`` raises ``ChaosError`` inside the worker,
+exercising the router's failover path (pinned in tests: no accepted
+request is silently dropped).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+from ..ft.chaos import NULL_CHAOS, ChaosError
+from ..obs import NULL
+from .engine import BUCKETS, InferenceEngine
+from .scheduler import ServiceModel, SLOScheduler, cost_model_weights
+
+
+class EngineReplica:
+    """Engine + scheduler pinned to one mesh device."""
+
+    def __init__(self, index: int, model: str = "vgg11", *,
+                 device=None, buckets: Sequence[int] = BUCKETS,
+                 precision: str = "f32", seed: int = 0, state=None,
+                 telemetry=None, cache_dir: Optional[str] = None,
+                 svc: Optional[ServiceModel] = None, cost_prior: bool = False,
+                 shed: bool = True, max_queue_images: int = 1024,
+                 chaos=NULL_CHAOS, slow_stall_s: float = 0.25,
+                 use_staging: bool = True):
+        tel = telemetry if telemetry is not None else NULL
+        self.index = int(index)
+        self.chaos = chaos
+        self.slow_stall_s = float(slow_stall_s)
+        self.engine = InferenceEngine(
+            model, buckets=buckets, precisions=(precision,), state=state,
+            seed=seed, telemetry=tel, cache_dir=cache_dir, device=device,
+            use_staging=use_staging)
+        if svc is None:
+            weights = cost_model_weights(self.engine, precision) \
+                if cost_prior else None
+            svc = ServiceModel(self.engine.buckets, weights=weights)
+        self.scheduler = SLOScheduler(
+            self.engine, svc=svc, shed=shed,
+            max_queue_images=max_queue_images, precision=precision,
+            telemetry=tel, replica=self.index,
+            dispatch_hook=self._chaos_hook)
+
+    def _chaos_hook(self, dispatch_no: int, bucket: int) -> None:
+        ch = self.chaos
+        if not ch.enabled:
+            return
+        if dispatch_no in ch.steps("slow_replica") \
+                and ch.seed_of("slow_replica", dispatch_no) == self.index \
+                and ch.fire("slow_replica", dispatch_no):
+            time.sleep(self.slow_stall_s)
+        if dispatch_no in ch.steps("replica_death") \
+                and ch.seed_of("replica_death", dispatch_no) == self.index \
+                and ch.fire("replica_death", dispatch_no):
+            raise ChaosError(
+                f"chaos: replica {self.index} died at dispatch "
+                f"{dispatch_no} (bucket {bucket})")
+
+    # -- passthroughs ------------------------------------------------------
+
+    def startup(self) -> dict:
+        return self.engine.startup()
+
+    def start(self) -> "EngineReplica":
+        self.scheduler.start()
+        return self
+
+    def stop(self) -> None:
+        self.scheduler.stop()
+
+    def __enter__(self) -> "EngineReplica":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def alive(self) -> bool:
+        return self.scheduler.alive
+
+    def outstanding_s(self) -> float:
+        return self.scheduler.outstanding_s()
+
+    def enqueue(self, req):
+        return self.scheduler.enqueue(req)

@@ -881,6 +881,57 @@ def test_lint_lock_owned_declaration_needs_a_lock():
     assert pylint_rules.lint_source(no_lock, "n.py") == []
 
 
+_SRC_ROUTER = """\
+import threading
+
+class Router:
+    _lock_owned = ("_routed", "_failovers")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._routed = 0
+        self._failovers = 0
+
+    def submit(self):
+        with self._lock:
+            self._routed += 1
+
+    def _handle_death(self):
+        self._failovers += 1
+"""
+
+
+def test_lint_lock_owned_covers_router_shape():
+    """Round 9: the serving router's failover counter is bumped from a
+    scheduler worker thread, not the caller's — an unlocked write in the
+    death handler is exactly the race the declaration must catch."""
+    bad = pylint_rules.lint_source(_SRC_ROUTER, "bad.py")
+    assert [f.rule for f in bad] == ["lock-ownership"]
+    assert "_handle_death" in bad[0].message \
+        and "_failovers" in bad[0].message
+    ok = _SRC_ROUTER.replace(
+        "    def _handle_death(self):\n        self._failovers += 1",
+        "    def _handle_death(self):\n        with self._lock:\n"
+        "            self._failovers += 1")
+    assert pylint_rules.lint_source(ok, "ok.py") == []
+
+
+def test_serving_tier_declares_lock_ownership():
+    """The live router/scheduler/frontend classes carry ``_lock_owned``
+    declarations, so the repo-wide lint gate (test_repo_lints_clean)
+    guards their mutable state from first write — not only after a
+    locked counterpart exists somewhere."""
+    from cs744_ddp_tpu.serve.frontend import FrontendClient, ServingFrontend
+    from cs744_ddp_tpu.serve.router import ReplicaRouter
+    from cs744_ddp_tpu.serve.scheduler import ServiceModel, SLOScheduler
+    assert set(ReplicaRouter._lock_owned) >= {"_routed", "_failovers"}
+    assert set(SLOScheduler._lock_owned) >= {"_pending", "_inflight",
+                                             "_dead", "_stop"}
+    assert set(ServiceModel._lock_owned) >= {"_ewma"}
+    assert set(ServingFrontend._lock_owned) >= {"_conns", "_running"}
+    assert set(FrontendClient._lock_owned) >= {"_futs", "_next_id"}
+
+
 def test_zoo_shrunk_world_audits_clean():
     """Round 6: the program set the elastic ladder degrades INTO (world 2
     and the world-1 synchronous fallback) certifies against the same cost

@@ -361,6 +361,27 @@ def test_emit_head_budget_worst_case_with_serving(tmp_path):
         == serving
 
 
+def test_emit_head_budget_with_committed_serving_load(tmp_path):
+    """Round 9: the committed BENCH_FULL.json now carries the fat
+    ``serving_load`` section (replica-scaling rows, goodput curve,
+    overload telemetry summary).  Re-emitting that REAL artifact must
+    still produce a final stdout line within the driver budget — the
+    new section rides in the sidecar, never the head."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "BENCH_FULL.json")) as f:
+        result = json.load(f)
+    assert "serving_load" in result
+    lines = []
+    head = bench.emit_result(result, str(tmp_path / "FULL.json"),
+                             out=lines.append)
+    final = lines[-1]
+    assert len(final.encode()) <= bench.HEAD_LINE_BUDGET
+    parsed = json.loads(final)
+    assert parsed == head
+    assert "serving_load" not in parsed
+    assert json.loads((tmp_path / "FULL.json").read_text()) == result
+
+
 def test_bench_require_real_data_gate(tmp_path, monkeypatch):
     # No pickle batches under the data dir -> refuse before measuring.
     monkeypatch.setenv("CIFAR_DATA_DIR", str(tmp_path))

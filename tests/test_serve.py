@@ -269,8 +269,11 @@ def test_microbatcher_bounded_queue_rejects():
                     break
             time.sleep(0.001)
         second = mb.submit(np.zeros((8, 32, 32, 3), np.uint8))
-        with pytest.raises(QueueFull):
+        with pytest.raises(QueueFull) as ei:
             mb.submit(np.zeros((1, 32, 32, 3), np.uint8))
+        # Backpressure hint: queue depth x service EWMA (10 ms prior
+        # before the first dispatch completes), never a bare reject.
+        assert ei.value.retry_after_ms > 0.0
         eng.gate.set()
         first.result(timeout=30)
         second.result(timeout=30)

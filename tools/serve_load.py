@@ -1,0 +1,127 @@
+"""Standalone serving load-trace generator + open-loop replay client.
+
+Two subcommands:
+
+* ``gen``    — write a seeded tiered load trace (``serve/demo.py``
+  ``synthetic_load_trace``) as JSON: ``{"trace": [[t_s, n_images, tier,
+  slo_ms], ...], "meta": {...}}``.  Deterministic in (seed, rps,
+  requests), so a committed trace file IS the workload.
+* ``replay`` — replay a trace file open-loop over the wire protocol
+  against a running ``--serve-frontend`` server (or ``gen`` + replay in
+  one shot with ``--rps``), printing the goodput/SLO-attainment stats
+  sheet as one JSON line.  Requests are submitted at their scheduled
+  arrival times regardless of completion — offered load is the
+  independent variable.
+
+Run:  python tools/serve_load.py gen --requests 2000 --rps 1000 \
+          --seed 0 -o trace.json
+      python tools/serve_load.py replay trace.json --port 7447
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cs744_ddp_tpu.serve import demo  # noqa: E402
+from cs744_ddp_tpu.serve.frontend import FrontendClient  # noqa: E402
+
+
+def _parse_tiers(spec):
+    """``tier:weight:slo_ms`` triples -> the tiers mixture tuple."""
+    if not spec:
+        return demo.DEFAULT_TIERS
+    tiers = []
+    for s in spec:
+        tier, weight, slo = s.split(":")
+        tiers.append((int(tier), float(weight), float(slo)))
+    return tuple(tiers)
+
+
+def gen_trace(args) -> dict:
+    trace = demo.synthetic_load_trace(
+        args.requests, offered_rps=args.rps, seed=args.seed,
+        tiers=_parse_tiers(args.tier))
+    return {
+        "trace": [[round(t, 9), n, tier, slo] for t, n, tier, slo in trace],
+        "meta": {"requests": args.requests, "offered_rps": args.rps,
+                 "seed": args.seed,
+                 "tiers": [list(t) for t in _parse_tiers(args.tier)]},
+    }
+
+
+def cmd_gen(args) -> int:
+    doc = gen_trace(args)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f)
+        print(f"wrote {len(doc['trace'])} requests to {args.out}")
+    else:
+        print(json.dumps(doc))
+    return 0
+
+
+def cmd_replay(args) -> int:
+    if args.trace:
+        with open(args.trace) as f:
+            doc = json.load(f)
+        trace = [tuple(row) for row in doc["trace"]]
+        seed = int(doc.get("meta", {}).get("seed", args.seed))
+    else:
+        if args.rps is None:
+            raise SystemExit("replay needs a trace file or --rps")
+        doc = gen_trace(args)
+        trace = [tuple(row) for row in doc["trace"]]
+        seed = args.seed
+    pool = demo.request_pool(seed=123)
+    with FrontendClient((args.host, args.port),
+                        timeout=args.timeout) as client:
+        stats = demo.replay_load(client, trace, pool=pool, seed=seed,
+                                 drain_timeout_s=args.timeout)
+    print(json.dumps(stats))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="seeded serving load-trace generator + open-loop "
+                    "replay client (wire protocol)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("gen", help="generate a seeded tiered load trace")
+    g.add_argument("--requests", type=int, default=1000)
+    g.add_argument("--rps", type=float, default=500.0,
+                   help="offered load, requests/sec")
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--tier", action="append", default=None,
+                   metavar="TIER:WEIGHT:SLO_MS",
+                   help="tier mixture entry (repeatable; default "
+                        "0:2:75 1:5:200 2:3:600)")
+    g.add_argument("-o", "--out", default=None,
+                   help="trace file (default: print one JSON line)")
+    g.set_defaults(fn=cmd_gen)
+
+    r = sub.add_parser("replay", help="replay a trace against a running "
+                                      "--serve-frontend server")
+    r.add_argument("trace", nargs="?", default=None,
+                   help="trace file from gen (omit to generate inline "
+                        "with --rps/--requests)")
+    r.add_argument("--host", default="127.0.0.1")
+    r.add_argument("--port", type=int, required=True)
+    r.add_argument("--requests", type=int, default=1000)
+    r.add_argument("--rps", type=float, default=None)
+    r.add_argument("--seed", type=int, default=0)
+    r.add_argument("--tier", action="append", default=None,
+                   metavar="TIER:WEIGHT:SLO_MS")
+    r.add_argument("--timeout", type=float, default=120.0,
+                   help="drain timeout seconds")
+    r.set_defaults(fn=cmd_replay)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -210,6 +210,63 @@ def _trace_lines(events) -> list:
     return lines
 
 
+def _slo_lines(events) -> list:
+    """SLO scheduling rendering (round 9): per-tier attainment from the
+    scheduler's ``serve_latency_ms`` gauges (``tier``/``met`` attrs),
+    shed counts by tier/reason, failovers, and per-replica utilization.
+    Returns [] for runs with no SLO signal — older runs render
+    unchanged."""
+    tiers = {}
+    shed_reasons = {}
+    failovers = 0
+    deaths = 0
+    util = {}
+    for e in events:
+        kind, name = e.get("kind"), e.get("name")
+        if kind == "gauge" and name == "serve_latency_ms" and "met" in e \
+                and "tier" in e:
+            agg = tiers.setdefault(e["tier"], {"served": 0, "met": 0,
+                                               "shed": 0})
+            agg["served"] += 1
+            agg["met"] += 1 if e["met"] else 0
+        elif kind == "counter" and name == "serve_shed":
+            if "tier" in e:
+                agg = tiers.setdefault(e["tier"], {"served": 0, "met": 0,
+                                                   "shed": 0})
+                agg["shed"] += int(e.get("inc", 1))
+            reason = str(e.get("reason", "unknown"))
+            shed_reasons[reason] = shed_reasons.get(reason, 0) \
+                + int(e.get("inc", 1))
+        elif kind == "counter" and name == "serve_failover":
+            failovers += int(e.get("inc", 1))
+        elif kind == "counter" and name == "replica_death":
+            deaths += int(e.get("inc", 1))
+        elif kind == "gauge" and name == "replica_util" and "replica" in e:
+            util[e["replica"]] = e["value"]
+    if not tiers and not shed_reasons and not util:
+        return []
+    lines = ["== slo (tiered attainment) =="]
+    for tier in sorted(tiers):
+        agg = tiers[tier]
+        offered = agg["served"] + agg["shed"]
+        att = agg["met"] / offered if offered else 0.0
+        lines.append(f"  tier {tier!s:<4} served {agg['served']:<6} "
+                     f"met {agg['met']:<6} late "
+                     f"{agg['served'] - agg['met']:<5} "
+                     f"shed {agg['shed']:<5} attainment {att:7.2%}")
+    if shed_reasons:
+        detail = ", ".join(f"{r} {n}" for r, n in sorted(shed_reasons.items()))
+        lines.append(f"  shed by reason         {detail}")
+    if deaths or failovers:
+        lines.append(f"  replica deaths         {deaths} "
+                     f"({failovers} requests failed over)")
+    if util:
+        detail = "  ".join(f"r{k} {v:.2f}" for k, v in sorted(util.items()))
+        lines.append(f"  replica utilization    {detail}")
+    lines.append("")
+    return lines
+
+
 def render(out_dir: str) -> str:
     manifest, events, summary = read_run(out_dir)
     # A preempted/killed run legitimately truncates the final event line;
@@ -277,6 +334,7 @@ def render(out_dir: str) -> str:
     lines.extend(_audit_lines(manifest))
     lines.extend(_attribution_lines(manifest))
     lines.extend(_trace_lines(events))
+    lines.extend(_slo_lines(events))
 
     gauges = {}
     for e in events:
