@@ -1012,6 +1012,187 @@ def run_serving_load(log, *, model: str = "servenet", buckets=None,
     return out
 
 
+def run_hotswap(log, *, model: str = "servenet", buckets=None,
+                n_replicas: int = 2, n_requests: int = 400,
+                offered_rps: float = 600.0, slo_ms: float = 2000.0,
+                queue_images: int = 4096, publishes_per_row: int = 3,
+                seed: int = 0, precision: str = "f32") -> dict:
+    """Train-to-serve weight hot-swap under load (``publish/`` round 10).
+
+    One steady row (no publishes) and two swap rows (rolling vs
+    all-at-once) replay the SAME seeded open-loop trace through the
+    replicated router while a background thread publishes fresh weight
+    bundles at fixed fractions of the trace span and a ``WeightWatcher``
+    installs each one at the schedulers' dispatch boundaries:
+
+    * ``swap_ms`` p50/p99/max — per-replica publish-pointer-seen ->
+      flip-landed latency from the watcher's own samples;
+    * ``in_flight_at_publish`` — queued images + predicted outstanding
+      seconds sampled across all replicas at each publish instant (the
+      work the swap must not tear);
+    * ``goodput_dip_pct`` — each swap row's goodput vs the steady row at
+      matched offered load (what a swap costs the SLO);
+    * ``recompiles`` — growth of every engine's executable cache across
+      the row; the AOT ladder treats weights as arguments, so this is
+      pinned at 0 (zero_recompiles rides in the section).
+
+    Standalone-callable, same contract as ``run_serving_load``."""
+    import tempfile
+    import threading
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from cs744_ddp_tpu import models
+    from cs744_ddp_tpu.models import get_model
+    from cs744_ddp_tpu.publish import WeightPublisher, WeightWatcher
+    from cs744_ddp_tpu.serve import (BUCKETS, EngineReplica, LoopbackClient,
+                                     ReplicaRouter, demo)
+    from cs744_ddp_tpu.train.step import init_train_state
+
+    log = log or (lambda s: print(s, file=sys.stderr))
+    buckets = tuple(buckets) if buckets else BUCKETS
+    if model == "servenet":
+        models.register_model("servenet", _servenet_factory)
+    devices = jax.devices()
+    log(f"[bench] hotswap: building {n_replicas} {model} replicas over "
+        f"{len(devices)} device(s)")
+    replicas = [EngineReplica(i, model=model,
+                              device=devices[i % len(devices)],
+                              buckets=buckets, precision=precision,
+                              seed=seed, cost_prior=True,
+                              max_queue_images=queue_images)
+                for i in range(n_replicas)]
+    for r in replicas:
+        r.startup()
+    pool = demo.request_pool(seed=seed + 123)
+    init_fn, _ = get_model(model)
+    # Fresh (independently initialised) weights per publish: a swap that
+    # installed identical bytes would be unobservable.
+    states = [init_train_state(init_fn, jax.random.PRNGKey(seed + 1 + k))
+              for k in range(2 * publishes_per_row)]
+    trace = demo.synthetic_load_trace(n_requests, offered_rps=offered_rps,
+                                      seed=seed + 7,
+                                      tiers=((0, 1, slo_ms),))
+    span_s = trace[-1][0]
+    drain_s = 2.0 + 3.0 * slo_ms / 1e3
+
+    def _replay():
+        router = ReplicaRouter(replicas)
+        with router:
+            client = LoopbackClient(router)
+            stats = demo.replay_load(client, trace, pool=pool, seed=seed,
+                                     drain_timeout_s=drain_s)
+        return stats, router.stats()
+
+    def _swap_row(rolling, row_states):
+        exec_counts = [len(r.engine._exec) for r in replicas]
+        samples = []
+        scheds = [r.scheduler for r in replicas]
+
+        with tempfile.TemporaryDirectory() as pub_dir:
+            pub = WeightPublisher(pub_dir, fingerprint={"model": model})
+            watcher = WeightWatcher(pub_dir, replicas, rolling=rolling,
+                                    poll_interval_s=0.02)
+
+            def _publish_mid():
+                t_start = _time.time()
+                for k, state in enumerate(row_states):
+                    target = span_s * (k + 1) / (len(row_states) + 1.0)
+                    dt = t_start + target - _time.time()
+                    if dt > 0:
+                        _time.sleep(dt)
+                    samples.append({
+                        "queued_images": sum(s.queue_depth()
+                                             for s in scheds),
+                        "outstanding_s": round(sum(s.outstanding_s()
+                                                   for s in scheds), 6),
+                    })
+                    pub.publish(state)
+
+            router = ReplicaRouter(replicas)
+            with router:
+                client = LoopbackClient(router)
+                watcher.start()
+                th = threading.Thread(target=_publish_mid, daemon=True)
+                th.start()
+                stats = demo.replay_load(client, trace, pool=pool,
+                                         seed=seed, drain_timeout_s=drain_s)
+                th.join()
+                # Deterministic close: the last publish may land between
+                # background polls — one awaited poll before stopping.
+                watcher.poll_once(wait=True)
+                watcher.stop()
+            rstats = router.stats()
+            rep = watcher.report()
+
+        swap_ms = sorted(rep["swap_ms"])
+        return {
+            "rolling": rolling,
+            "publishes": len(row_states),
+            "installs": rep["installed"],
+            "installed_version": rep["installed_version"],
+            "weights_versions": [e["weights_version"]
+                                 for e in rstats["replicas"]],
+            "swap_ms_p50": round(float(np.percentile(swap_ms, 50)), 3),
+            "swap_ms_p99": round(float(np.percentile(swap_ms, 99)), 3),
+            "swap_ms_max": round(swap_ms[-1], 3),
+            "swap_samples": len(swap_ms),
+            "in_flight_at_publish": samples,
+            "recompiles": sum(len(r.engine._exec) - c
+                              for r, c in zip(replicas, exec_counts)),
+            "goodput_rps": stats["goodput_rps"],
+            "attainment": stats["attainment"],
+            "replies": stats["replies"],
+            "unresolved": stats["unresolved"],
+        }
+
+    out = {
+        "backend": jax.default_backend(),
+        "model": model,
+        "replicas": n_replicas,
+        "offered_rps": round(n_requests / max(span_s, 1e-9), 1),
+        "slo_ms": slo_ms,
+        "provenance": (
+            "same single-physical-core host caveat as serving_load; the "
+            "swap rows replay the steady row's exact trace while a "
+            "background publisher lands fresh bundles at fixed fractions "
+            "of the span, so the goodput dip is attributable to the swap "
+            "machinery alone."),
+    }
+
+    log(f"[bench] hotswap: steady row, {n_requests} reqs @ "
+        f"{out['offered_rps']} rps, SLO {slo_ms:g} ms")
+    steady, _rs = _replay()
+    out["steady"] = {"goodput_rps": steady["goodput_rps"],
+                     "attainment": steady["attainment"],
+                     "replies": steady["replies"],
+                     "unresolved": steady["unresolved"]}
+
+    for rolling in (True, False):
+        name = "rolling" if rolling else "all_at_once"
+        row_states = states[:publishes_per_row] if rolling \
+            else states[publishes_per_row:]
+        log(f"[bench] hotswap: {name} swap row, "
+            f"{publishes_per_row} publishes mid-trace")
+        row = _swap_row(rolling, row_states)
+        row["goodput_dip_pct"] = round(
+            100.0 * (1.0 - row["goodput_rps"]
+                     / max(steady["goodput_rps"], 1e-9)), 2)
+        out[name] = row
+        log(f"[bench] hotswap: {name} swap_ms p50 {row['swap_ms_p50']} "
+            f"p99 {row['swap_ms_p99']}, recompiles {row['recompiles']}, "
+            f"goodput dip {row['goodput_dip_pct']}%")
+
+    out["zero_recompiles"] = (out["rolling"]["recompiles"] == 0
+                              and out["all_at_once"]["recompiles"] == 0)
+    if not out["zero_recompiles"]:
+        log("[bench] hotswap: WARNING executable caches GREW across a "
+            "swap row — the weights-as-arguments contract is broken")
+    return out
+
+
 def run_elastic(log, *, headline_model: str = "vgg11", ndev=None,
                 global_batch: int = 256, data_dir: str = "./data",
                 max_iters: int = 50, microshards: int = 4) -> dict:
@@ -1343,6 +1524,7 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
               compression: bool = True,
               robustness: bool = True, serving: bool = True,
               serving_load: bool = True,
+              hotswap: bool = True,
               elastic: bool = True,
               audit: bool = True,
               attribution: bool = True,
@@ -1673,6 +1855,13 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
     if serving_load:
         result["serving_load"] = run_serving_load(log)
 
+    # Train-to-serve weight hot-swap (round 10): swap latency p50/p99,
+    # in-flight work at each publish instant, goodput dip vs the steady
+    # row, rolling vs all-at-once — zero recompiles pinned
+    # (cs744_ddp_tpu/publish/).
+    if hotswap:
+        result["hotswap"] = run_hotswap(log)
+
     # Elastic layer: shrink/grow resume latency, steps lost, and
     # degraded single-rank throughput (cs744_ddp_tpu/elastic/).
     if elastic:
@@ -1865,6 +2054,11 @@ def main(argv=None) -> None:
                         "scaling at fixed SLO, goodput-vs-offered curve, "
                         "2x tiered overload with confined shedding, "
                         "continuous-vs-drain queue-wait)")
+    p.add_argument("--no-hotswap", action="store_true",
+                   help="skip the weight hot-swap section (swap latency "
+                        "p50/p99, in-flight work at publish, goodput dip "
+                        "vs steady, rolling vs all-at-once, zero-recompile "
+                        "pin)")
     p.add_argument("--no-elastic", action="store_true",
                    help="skip the elastic section (shrink/grow resume "
                         "latency, steps lost, degraded single-rank "
@@ -1918,6 +2112,7 @@ def main(argv=None) -> None:
                        serving=not (args.no_serving or args.no_matrix),
                        serving_load=not (args.no_serving_load
                                          or args.no_matrix),
+                       hotswap=not (args.no_hotswap or args.no_matrix),
                        elastic=not (args.no_elastic or args.no_matrix),
                        audit=not (args.no_audit or args.no_matrix),
                        attribution=not (args.no_attribution

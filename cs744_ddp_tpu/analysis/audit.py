@@ -554,6 +554,7 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
               include_eval: bool = True,
               serve_buckets: Sequence[int] = (),
               serve_precision: Optional[str] = None,
+              serve_swap_recert: bool = False,
               num_devices: Optional[int] = None,
               waive: Sequence[str] = (),
               max_constant_bytes: int = DEFAULT_MAX_CONSTANT_BYTES,
@@ -711,7 +712,8 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
             model=model, buckets=serve_buckets,
             precision=serve_precision or precision, waive=waive,
             max_constant_bytes=max_constant_bytes,
-            hlo_out=result.hlo if collect_hlo else None))
+            hlo_out=result.hlo if collect_hlo else None,
+            swap_recert=serve_swap_recert))
 
     if world > 1 and len(window_depths) > 1:
         result.ladder, result.ladder_findings = _certify_ladder(
@@ -729,13 +731,23 @@ def audit_serving(*, model: str = "vgg11",
                   waive: Sequence[str] = (),
                   max_constant_bytes: int = DEFAULT_MAX_CONSTANT_BYTES,
                   hlo_out: Optional[Dict[str, str]] = None,
+                  swap_recert: bool = False, swap_seed: int = 1,
                   ) -> List[AuditReport]:
     """Audit the serving executable ladder: one single-device program per
     bucket, required collective-free, precision-certified, constant-lean.
     Pass ``engine`` to audit an already-built :class:`InferenceEngine`
     (the bench serving section does); otherwise one is built without
     staging or caches.  ``hlo_out`` (a dict) collects each rung's
-    lowering text under its program name for cost-model attribution."""
+    lowering text under its program name for cost-model attribution.
+
+    ``swap_recert`` re-certifies the ladder under the publish/ hot-swap
+    path: differently-seeded weights are installed through
+    ``engine.install_weights`` (the same entry point a live swap uses)
+    and every rung is re-lowered and re-audited as
+    ``serve_swap/b{bucket}/{precision}`` — the baked-constants rule on
+    the POST-swap program set proves the executables stay weight-
+    agnostic across installs (weights remain runtime arguments, never
+    folded), which is what makes the zero-recompile swap sound."""
     if engine is None:
         from ..serve import InferenceEngine
         engine = InferenceEngine(model, buckets=tuple(buckets),
@@ -743,15 +755,28 @@ def audit_serving(*, model: str = "vgg11",
                                  use_staging=False,
                                  enable_compilation_cache=False)
     reports = []
-    for b in engine.buckets:
-        name = f"serve/b{b}/{precision}"
-        c = ProgramContract(
-            name=name, strategy=None, world=1,
-            precision=precision, max_constant_bytes=max_constant_bytes)
-        text = engine.lowered_hlo(b, precision)
-        reports.append(audit_program(text, c, waive=waive))
-        if hlo_out is not None:
-            hlo_out[name] = text
+
+    def _audit_rungs(prefix: str) -> None:
+        for b in engine.buckets:
+            name = f"{prefix}/b{b}/{precision}"
+            c = ProgramContract(
+                name=name, strategy=None, world=1,
+                precision=precision, max_constant_bytes=max_constant_bytes)
+            text = engine.lowered_hlo(b, precision)
+            reports.append(audit_program(text, c, waive=waive))
+            if hlo_out is not None:
+                hlo_out[name] = text
+
+    _audit_rungs("serve")
+    if swap_recert:
+        import jax
+        from ..models import get_model
+        from ..train.step import init_train_state
+        init_fn, _ = get_model(engine.model_name)
+        alt = init_train_state(init_fn, jax.random.PRNGKey(swap_seed))
+        engine.install_weights(alt.params, alt.bn_state,
+                               engine.weights_version + 1)
+        _audit_rungs("serve_swap")
     return reports
 
 

@@ -106,6 +106,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="save TrainState after each epoch and auto-resume "
                         "from the latest checkpoint (beyond-parity: the "
                         "reference has no checkpointing)")
+    p.add_argument("--publish-dir", default=None,
+                   help="publish the serving weights (params + BN stats) "
+                        "as a versioned crc-checksummed bundle into this "
+                        "directory every --publish-every completed epochs; "
+                        "a serving process started with "
+                        "--serve-publish-dir on the same directory "
+                        "hot-swaps each version between dispatches with "
+                        "zero recompiles (publish/)")
+    p.add_argument("--publish-every", type=int, default=1, metavar="K",
+                   help="publish every K completed epochs (default 1); "
+                        "only meaningful with --publish-dir")
     p.add_argument("--metrics-ring", type=int, default=None, metavar="N",
                    help="device-resident metric ring capacity for the "
                         "windowed train paths (obs/ringbuf.py): per-step "
@@ -145,8 +156,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "coordinator_loss fires on recovery progress "
                          "(requires --elastic). Replica-level sites (third "
                          "field is the target REPLICA, step counts its own "
-                         "dispatches): replica_death, slow_replica "
-                         "(requires --serve-frontend)")
+                         "dispatches): replica_death, slow_replica, and "
+                         "swap_mid_batch (a pending publish races a live "
+                         "dispatch: the racing dispatch is answered by the "
+                         "OLD weights, the next by the new) "
+                         "(requires --serve-frontend). Publish-level sites "
+                         "(step counts the publisher's own publishes, "
+                         "third field is a payload seed): publish_torn "
+                         "(bundle corrupted after rename — rejected on "
+                         "crc, old version keeps serving), publish_stale "
+                         "(re-announces the previous version — skipped) "
+                         "(require --publish-dir)")
     ft.add_argument("--ft-put-timeout", type=float, default=30.0,
                     metavar="SECONDS",
                     help="watchdog deadline on each staged chunk device_put")
@@ -232,6 +252,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="deadline-aware load shedding in the scheduler "
                          "(off = serve everything, late replies included "
                          "— the no-shed ablation)")
+    sv.add_argument("--serve-publish-dir", default=None, metavar="DIR",
+                    help="watch DIR for published weight bundles (a "
+                         "--publish-dir training run's output) and "
+                         "hot-swap every replica to each new version "
+                         "between dispatches — zero restarts, zero "
+                         "recompiles; replies carry the serving "
+                         "model_version (only with --serve-frontend)")
+    sv.add_argument("--serve-publish-poll-ms", type=float, default=50.0,
+                    metavar="MS",
+                    help="publish-directory poll interval for "
+                         "--serve-publish-dir (default 50 ms)")
     au = p.add_argument_group(
         "static analysis (analysis/)",
         "HLO/jaxpr program audit: certify each compiled program's cost "
@@ -305,7 +336,7 @@ def audit_main(args, telemetry) -> None:
         model=args.model, global_batch=args.batch_size,
         precision=args.precision,
         serve_buckets=demo.parse_buckets(args.serve_buckets),
-        serve_precision=args.serve_precision,
+        serve_precision=args.serve_precision, serve_swap_recert=True,
         num_devices=args.num_devices, waive=args.audit_waive or (),
         metrics_ring=args.metrics_ring != 0, collect_hlo=collect)
     if collect:
@@ -398,26 +429,44 @@ def serve_frontend_main(args, telemetry) -> None:
     tiers = demo.DEFAULT_TIERS if args.serve_slo_ms is None \
         else ((0, 1, float(args.serve_slo_ms)),)
     router = ReplicaRouter(replicas, telemetry=telemetry)
+    watcher = None
+    if args.serve_publish_dir is not None:
+        from .publish import WeightWatcher
+        watcher = WeightWatcher(
+            args.serve_publish_dir, replicas, telemetry=telemetry,
+            chaos=chaos,
+            poll_interval_s=args.serve_publish_poll_ms / 1e3)
     stats = {}
     sizes = tuple(s for s in demo.SIZE_CHOICES if s <= buckets[-1])
     address = None
     with router:
+        if watcher is not None:
+            watcher.start()
         frontend = ServingFrontend(router, port=args.serve_port,
                                    telemetry=telemetry)
-        with frontend:
-            address = frontend.address
-            pool = demo.request_pool()
-            for rps in (args.serve_load or [20.0]):
-                trace = demo.synthetic_load_trace(
-                    args.serve_requests, offered_rps=rps,
-                    seed=args.serve_seed, size_choices=sizes, tiers=tiers)
-                with FrontendClient(frontend.address) as client:
-                    stats[f"{rps:g}rps"] = demo.replay_load(
-                        client, trace, pool=pool, seed=args.serve_seed)
+        try:
+            with frontend:
+                address = frontend.address
+                pool = demo.request_pool()
+                for rps in (args.serve_load or [20.0]):
+                    trace = demo.synthetic_load_trace(
+                        args.serve_requests, offered_rps=rps,
+                        seed=args.serve_seed, size_choices=sizes, tiers=tiers)
+                    with FrontendClient(frontend.address) as client:
+                        stats[f"{rps:g}rps"] = demo.replay_load(
+                            client, trace, pool=pool, seed=args.serve_seed)
+        finally:
+            if watcher is not None:
+                watcher.stop()
+    out = {"address": list(address), "startup": startup,
+           "router": router.stats(), "load": stats}
+    if watcher is not None:
+        out["publish"] = watcher.report()
     if telemetry.enabled:
         telemetry.update_manifest({"router": router.stats()})
-    print(json.dumps({"address": list(address), "startup": startup,
-                      "router": router.stats(), "load": stats}))
+        if watcher is not None:
+            telemetry.update_manifest({"publish": watcher.report()})
+    print(json.dumps(out))
 
 
 def serve_main(args, telemetry) -> None:
@@ -544,7 +593,9 @@ def main(argv=None) -> None:
                 waive=args.audit_waive or (),
                 metrics_ring=bool(trainer.metrics_ring)))
         trainer.run(args.epochs, checkpoint_dir=args.checkpoint_dir,
-                    profile_dir=args.profile_dir)
+                    profile_dir=args.profile_dir,
+                    publish_dir=args.publish_dir,
+                    publish_every=args.publish_every)
     finally:
         # summary.json even on an interrupted run — partial runs are the
         # ones whose artifact is most needed.  Cache hit/miss tallies are

@@ -11,8 +11,8 @@ from __future__ import annotations
 
 from typing import Any, NamedTuple
 
-from .chaos import (NULL_CHAOS, RANK_SITES, REPLICA_SITES, ChaosError,
-                    ChaosPlan, NullChaos, RankDeathError, SITES)
+from .chaos import (NULL_CHAOS, PUBLISH_SITES, RANK_SITES, REPLICA_SITES,
+                    ChaosError, ChaosPlan, NullChaos, RankDeathError, SITES)
 from .guard import POLICIES, NonFiniteError
 from .preempt import PreemptedError, PreemptionGuard
 from .supervisor import (StagingStalled, Watchdog, batch_checksums,
@@ -57,7 +57,7 @@ class FTConfig(NamedTuple):
 
 __all__ = [
     "FTConfig", "ChaosPlan", "ChaosError", "NullChaos", "NULL_CHAOS", "SITES",
-    "RANK_SITES", "REPLICA_SITES", "RankDeathError",
+    "PUBLISH_SITES", "RANK_SITES", "REPLICA_SITES", "RankDeathError",
     "POLICIES", "NonFiniteError", "PreemptedError", "PreemptionGuard",
     "StagingStalled", "Watchdog", "call_with_retry", "batch_checksums",
     "verify_checksums",

@@ -54,7 +54,28 @@ replica's OWN dispatches (``replica_death:dispatch:replica``):
 * ``slow_replica``     — the replica stalls ``slow_stall_s`` before its
                          dispatch ``step`` (a straggling chip); the
                          least-loaded router routes around it as its
-                         measured service EWMA inflates.
+                         measured service EWMA inflates;
+* ``swap_mid_batch``   — the replica's weight-watcher probe is invoked
+                         INSIDE the dispatch hook of dispatch ``step``
+                         (``swap_mid_batch:dispatch:replica``): a
+                         pending publish races the dispatch already
+                         being assembled.  The pin: the racing dispatch
+                         is answered bitwise by the OLD weights (the
+                         install lands at the next engine-free instant),
+                         the next dispatch by the new — never a mix.
+
+Publish-level sites (publish/ — round 10's train-to-serve hot-swap).
+``step`` counts the publisher's OWN publishes (0-based) and the third
+spec field is a payload seed (``publish_torn:publish[:seed]``):
+
+* ``publish_torn``     — the published bundle's payload bytes are
+                         corrupted (seeded XOR) AFTER the atomic rename,
+                         so the file is well-formed but fails its
+                         per-leaf crc32 — the watcher must reject it and
+                         keep serving the old version;
+* ``publish_stale``    — the publish re-announces the PREVIOUS version
+                         (a duplicate/late publisher): the watcher must
+                         skip it without staging or swapping anything.
 
 The disabled plan is ``NULL_CHAOS`` — a stateless singleton exactly like
 the telemetry ``NULL`` recorder: ``enabled`` is False, ``fire*`` return
@@ -69,13 +90,17 @@ from typing import List, Optional, Sequence, Tuple
 
 SITES = ("producer_crash", "put_delay", "put_fail", "corrupt_slot",
          "nonfinite_grad", "preempt", "rank_death", "slow_rank",
-         "coordinator_loss", "replica_death", "slow_replica")
+         "coordinator_loss", "replica_death", "slow_replica",
+         "publish_torn", "swap_mid_batch", "publish_stale")
 # Sites whose third spec field names the target RANK (elastic/), not a
 # payload seed — same wire format, different interpretation.
 RANK_SITES = ("rank_death", "slow_rank")
 # Sites whose third spec field names the target serving REPLICA and whose
 # step counts that replica's own dispatches (serve/replica.py).
-REPLICA_SITES = ("replica_death", "slow_replica")
+REPLICA_SITES = ("replica_death", "slow_replica", "swap_mid_batch")
+# Sites fired by the weight publisher (publish/publisher.py): step counts
+# the publisher's own publishes, the third field is a payload seed.
+PUBLISH_SITES = ("publish_torn", "publish_stale")
 
 
 class ChaosError(RuntimeError):

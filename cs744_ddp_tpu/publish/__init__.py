@@ -1,0 +1,25 @@
+"""Train-to-serve weight hot-swap (round 10).
+
+The missing link between the trainer and the serving tier: the trainer
+publishes versioned, crc-checksummed weight bundles into a watched
+directory (``WeightPublisher``), and live replicas install them between
+dispatches with zero recompiles, zero dropped requests, and a bitwise
+A/B guarantee per request (``WeightWatcher``).  The swap is possible
+without recompiling precisely because the serving executables are
+weight-AGNOSTIC — weights are runtime arguments, certified unbaked by
+the ``analysis/audit.py`` baked-constants rule — so a new version is
+just a new argument reference, flipped at a dispatch boundary.
+"""
+
+from __future__ import annotations
+
+from .bundle import (LATEST, BundleError, bundle_nbytes, leaf_signature,
+                     read_bundle, read_latest, read_manifest, write_bundle)
+from .publisher import WeightPublisher
+from .watcher import WeightWatcher
+
+__all__ = [
+    "WeightPublisher", "WeightWatcher", "BundleError",
+    "write_bundle", "read_bundle", "read_manifest", "read_latest",
+    "leaf_signature", "bundle_nbytes", "LATEST",
+]

@@ -1,0 +1,252 @@
+"""Serving-side weight watcher: poll the publish directory, validate,
+stage, and swap — between dispatches, never during one.
+
+``WeightWatcher`` owns the whole install pipeline for a set of live
+``EngineReplica``s:
+
+1. follow the directory's ``LATEST`` pointer (cheap: one small json read
+   per poll; unchanged pointer -> no work);
+2. skip stale/duplicate versions (``publish_stale`` drill);
+3. fully read + crc-verify the bundle (``publish_torn`` -> rejected, the
+   old version keeps serving untouched);
+4. validate the bundle's pytree structure and per-leaf (shape, dtype)
+   against each engine's OWN abstract signature — the exact fields its
+   executables were keyed on, so a valid install can never invalidate
+   the AOT ladder (zero recompiles by construction);
+5. stage the leaves onto each replica's device HERE, on the watcher's
+   thread, off the serving worker's critical path;
+6. hand each replica's scheduler a flip closure via
+   ``request_install`` — the worker runs it at its next loop boundary,
+   when no dispatch is in flight, so a batch never sees torn weights
+   and every reply's ``model_version`` tag is exact.
+
+Rolling vs all-at-once: with ``rolling=True`` (default) replicas are
+swapped one at a time, each install awaited before the next is queued,
+so serving capacity never drops to zero; ``rolling=False`` queues every
+replica's flip at once (each still lands at that replica's own dispatch
+boundary) — the bench's ``run_hotswap`` section measures both.
+
+The ``swap_mid_batch`` chaos site calls ``poll_once(wait=False)`` from
+INSIDE a dispatch hook (via ``EngineReplica.swap_probe``).  That path
+must never block: it uses a non-blocking lock acquire (a concurrent
+poll just reports "busy") and never waits on install futures — the
+racing dispatch completes on the old weights, the flip lands at the
+next boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..ft.chaos import NULL_CHAOS
+from ..obs import NULL
+from . import bundle as bundlelib
+
+
+class WeightWatcher:
+    """Poll/validate/stage/swap driver for one publish directory."""
+
+    # Lock discipline (analysis/pylint_rules.py): every field mutated
+    # under self._lock.
+    _lock_owned = ("_installed_version", "_pointer", "_counts",
+                   "_swap_ms", "_thread", "_stop")
+
+    def __init__(self, directory: str, replicas: Sequence, *,
+                 telemetry=None, chaos=NULL_CHAOS, rolling: bool = True,
+                 poll_interval_s: float = 0.05,
+                 install_timeout_s: float = 30.0,
+                 attach_probes: bool = True):
+        self.directory = directory
+        self.replicas = list(replicas)
+        self.telemetry = telemetry if telemetry is not None else NULL
+        self.chaos = chaos
+        self.rolling = bool(rolling)
+        self.poll_interval_s = float(poll_interval_s)
+        self.install_timeout_s = float(install_timeout_s)
+        self._lock = threading.Lock()
+        self._installed_version = 0
+        self._pointer: Optional[dict] = None   # last LATEST content seen
+        self._counts: Dict[str, int] = {
+            "polls": 0, "installed": 0, "rejected": 0, "stale": 0}
+        self._swap_ms: List[float] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        if attach_probes:
+            for r in self.replicas:
+                r.swap_probe = self._probe
+
+    # -- the poll/install pipeline ----------------------------------------
+
+    def _probe(self) -> None:
+        """The swap_mid_batch entry point — called inside a dispatch hook
+        on the scheduler WORKER thread, so it must never block (waiting
+        on an install future would deadlock the worker against itself)."""
+        self.poll_once(wait=False)
+
+    def poll_once(self, wait: bool = True) -> str:
+        """One poll of the publish directory.  Returns what happened:
+        "none" (pointer unchanged / nothing published), "busy" (another
+        poll in progress, non-blocking path only), "stale" (version
+        already installed or older — skipped), "rejected" (torn bundle
+        or signature mismatch — old version keeps serving), "pending"
+        (installs queued, not awaited — ``wait=False``), or
+        "installed" (every replica flipped)."""
+        if not self._lock.acquire(blocking=wait):
+            return "busy"
+        try:
+            return self._poll_locked(wait)
+        finally:
+            self._lock.release()
+
+    def _poll_locked(self, wait: bool) -> str:
+        # Caller (poll_once) holds _lock via the non-blocking acquire —
+        # the lexical lint cannot see a conditional acquire, hence the
+        # waivers on this call tree's writes.
+        tel = self.telemetry
+        self._counts["polls"] += 1          # lint: ok(lock-ownership)
+        try:
+            latest = bundlelib.read_latest(self.directory)
+        except bundlelib.BundleError:
+            # A malformed pointer is a real fault (it is written
+            # atomically); reject, keep serving.
+            self._reject(tel, "pointer")
+            return "rejected"
+        if latest is None or latest == self._pointer:
+            return "none"
+        self._pointer = dict(latest)        # lint: ok(lock-ownership)
+        version = int(latest["version"])
+        if version <= self._installed_version:
+            self._counts["stale"] += 1      # lint: ok(lock-ownership)
+            if tel.enabled:
+                tel.counter("publish_stale_skipped", version=version,
+                            installed=self._installed_version)
+            return "stale"
+
+        path = os.path.join(self.directory, latest["file"])
+        try:
+            manifest, leaves = bundlelib.read_bundle(path)
+        except (bundlelib.BundleError, OSError) as e:
+            self._reject(tel, "crc", version=version, error=str(e))
+            return "rejected"
+        err = self._validate(manifest, leaves)
+        if err:
+            self._reject(tel, "signature", version=version, error=err)
+            return "rejected"
+
+        status = self._install_all(manifest, leaves, version, wait)
+        if tel.enabled and status == "installed":
+            tel.counter("publish_installed", version=version)
+            tel.gauge("installed_version", version)
+        return status
+
+    def _reject(self, tel, why: str, **attrs) -> None:
+        # Called from _poll_locked only: caller holds _lock.
+        self._counts["rejected"] += 1       # lint: ok(lock-ownership)
+        if tel.enabled:
+            tel.counter("publish_rejected", why=why, **attrs)
+
+    def _validate(self, manifest: dict, leaves) -> str:
+        """Bundle vs every engine's abstract signature; "" when clean."""
+        sig = (manifest["treedef"], bundlelib.leaf_signature(leaves))
+        fp_model = manifest.get("fingerprint", {}).get("model")
+        for r in self.replicas:
+            eng = r.engine
+            treedef, eleaves = eng._key_fields["abstract"]
+            want = (treedef, tuple((tuple(s), d) for s, d in eleaves))
+            if sig != want:
+                return (f"bundle signature does not match replica "
+                        f"{r.index}'s abstract model signature")
+            if fp_model is not None and fp_model != eng.model_name:
+                return (f"bundle fingerprint model {fp_model!r} != "
+                        f"engine model {eng.model_name!r}")
+        return ""
+
+    def _install_all(self, manifest, leaves, version: int,
+                     wait: bool) -> str:
+        import jax
+
+        futures = []
+        for r in self.replicas:
+            eng = r.engine
+            # Unflatten with the ENGINE's treedef object (the bundle's
+            # treedef string was validation only), staging each leaf to
+            # this replica's device here on the watcher thread.
+            _, treedef = jax.tree_util.tree_flatten(
+                (eng.params, eng.bn_state))
+            staged = leaves
+            if eng.device is not None:
+                staged = [jax.device_put(l, eng.device) for l in leaves]
+            params, bn_state = jax.tree_util.tree_unflatten(treedef, staged)
+
+            def flip(eng=eng, params=params, bn_state=bn_state):
+                eng.install_weights(params, bn_state, version,
+                                    assume_staged=True)
+
+            t0 = time.perf_counter()
+            fut = r.scheduler.request_install(flip)
+            futures.append((r, t0, fut))
+            if wait and self.rolling:
+                self._await(r, t0, fut)
+                futures.pop()
+        if wait:
+            for r, t0, fut in futures:
+                self._await(r, t0, fut)
+        # The version is claimed as installed once every flip is queued:
+        # each scheduler runs it at its next boundary (or inline at
+        # stop()), and re-queueing on the next poll would double-install.
+        # Called from _poll_locked only: caller holds _lock.
+        self._installed_version = version   # lint: ok(lock-ownership)
+        self._counts["installed"] += 1      # lint: ok(lock-ownership)
+        return "installed" if wait else "pending"
+
+    def _await(self, replica, t0: float, fut) -> None:
+        # Called from _install_all only: caller holds _lock.
+        fut.result(timeout=self.install_timeout_s)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._swap_ms.append(ms)            # lint: ok(lock-ownership)
+        if self.telemetry.enabled:
+            self.telemetry.gauge("swap_ms", ms, replica=replica.index)
+
+    # -- background polling ------------------------------------------------
+
+    def start(self) -> "WeightWatcher":
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._run, name="weight-watcher", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop = True
+            t = self._thread
+            self._thread = None
+        if t is not None:
+            t.join(timeout=self.install_timeout_s)
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+            self.poll_once(wait=True)
+            time.sleep(self.poll_interval_s)
+
+    # -- reporting ---------------------------------------------------------
+
+    @property
+    def installed_version(self) -> int:
+        with self._lock:
+            return self._installed_version
+
+    def report(self) -> dict:
+        with self._lock:
+            return {"installed_version": self._installed_version,
+                    "swap_ms": list(self._swap_ms),
+                    **dict(self._counts)}

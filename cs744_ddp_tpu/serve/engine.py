@@ -88,6 +88,9 @@ class InferenceEngine:
             state = init_train_state(init_fn, jax.random.PRNGKey(seed))
         self.params = state.params
         self.bn_state = state.bn_state
+        # Bumped by install_weights() (publish/ hot-swap); tagged into
+        # every Reply so the A/B pin is checkable per request.
+        self.weights_version = 0
         # Replica pinning: with an explicit device, weights live there and
         # every lowering bakes a SingleDeviceSharding for it, so N replicas
         # occupy N distinct mesh devices instead of piling onto device 0.
@@ -128,6 +131,48 @@ class InferenceEngine:
             "device_kind": getattr(d0, "device_kind", str(d0)),
             "device_id": int(getattr(d0, "id", 0)),
         }
+
+    # -- weight hot-swap ----------------------------------------------------
+
+    def install_weights(self, params, bn_state, version: int, *,
+                        assume_staged: bool = False) -> None:
+        """Flip the engine's weight references to a new version.
+
+        Weights are runtime ARGUMENTS of the AOT executables (certified
+        unbaked by the audit's baked-constants rule), so this is a pure
+        reference swap: no executable is touched, nothing recompiles.
+        The new tree must match the abstract signature the ladder was
+        compiled against — shape/dtype/structure drift would silently
+        desync the executables from their arguments, so it is rejected
+        here rather than at the next dispatch.
+
+        NOT internally synchronized: the caller must guarantee no
+        dispatch is concurrently reading ``self.params`` (the scheduler
+        runs installs at its loop boundary via ``request_install``, when
+        the worker — the only dispatcher — is provably between batches).
+
+        ``assume_staged=True`` skips the device_put (the watcher stages
+        leaves onto this engine's device beforehand, off the serving
+        worker's critical path).
+        """
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten((params, bn_state))
+        want_treedef, want_leaves = self._key_fields["abstract"]
+        got = (str(treedef), tuple((l.shape, str(l.dtype)) for l in leaves))
+        if got != (want_treedef, want_leaves):
+            raise ValueError(
+                f"install_weights: tree does not match the abstract "
+                f"signature the executable ladder was compiled against "
+                f"(model {self.model_name!r})")
+        if not assume_staged and self.device is not None:
+            params = jax.device_put(params, self.device)
+            bn_state = jax.device_put(bn_state, self.device)
+        self.params = params
+        self.bn_state = bn_state
+        self.weights_version = int(version)
+        if self.telemetry.enabled:
+            self.telemetry.counter("weights_installed", version=version)
 
     # -- ladder -------------------------------------------------------------
 

@@ -5,9 +5,11 @@ Wire format (all little-endian, u32 frame-length prefix per message):
 * request  = ``<IBBdH`` header (req_id u32, msg u8 = 1, tier u8,
   slo_ms f64 — <= 0 means no deadline, n u16) + n x 3072 raw u8 bytes
   (n CIFAR images, HWC 32x32x3).
-* reply    = ``<IBBQdddH`` header (req_id u32, status u8, reason u8,
+* reply    = ``<IBBQdddiH`` header (req_id u32, status u8, reason u8,
   trace u64, retry_after_ms f64, queue_wait_ms f64, service_ms f64,
-  n u16) + n x 10 f32 logits when status is ok/late.
+  model_version i32 — the engine weights version that served the
+  request (publish/ hot-swap A/B pin), -1 when it never reached a
+  dispatch, n u16) + n x 10 f32 logits when status is ok/late.
 
 Statuses: 0 ok, 1 late (served past deadline), 2 shed, 3 overload
 (rejected at admission — ``retry_after_ms`` carries the micro-batcher's
@@ -40,7 +42,7 @@ MSG_INFER = 1
 
 _LEN = struct.Struct("<I")
 _REQ = struct.Struct("<IBBdH")
-_REP = struct.Struct("<IBBQdddH")
+_REP = struct.Struct("<IBBQdddiH")
 
 STATUS_CODES = {"ok": 0, "late": 1, "shed": 2, "overload": 3, "error": 4}
 STATUS_NAMES = {v: k for k, v in STATUS_CODES.items()}
@@ -94,16 +96,18 @@ def encode_reply(req_id: int, reply) -> bytes:
     reason = get("reason") or ""
     rcode = REASON_CODES.get(reason.split(":")[0],
                              REASON_CODES["internal"] if reason else 0)
+    mv = get("model_version")
     return _REP.pack(req_id & 0xFFFFFFFF, status, rcode,
                      int(get("trace") or 0), float(get("retry_after_ms") or 0.0),
                      float(get("queue_wait_ms") or 0.0),
-                     float(get("service_ms") or 0.0), n) + blob
+                     float(get("service_ms") or 0.0),
+                     -1 if mv is None else int(mv), n) + blob
 
 
 def decode_reply(payload: bytes) -> dict:
     if len(payload) < _REP.size:
         raise ValueError(f"short reply frame ({len(payload)} B)")
-    req_id, status, rcode, trace, retry, qw, svc, n = \
+    req_id, status, rcode, trace, retry, qw, svc, mv, n = \
         _REP.unpack_from(payload)
     body = payload[_REP.size:]
     logits = None
@@ -114,7 +118,7 @@ def decode_reply(payload: bytes) -> dict:
     return {"req_id": req_id, "status": STATUS_NAMES.get(status, "error"),
             "reason": REASON_NAMES.get(rcode, "internal"), "trace": trace,
             "retry_after_ms": retry, "queue_wait_ms": qw, "service_ms": svc,
-            "logits": logits}
+            "model_version": mv, "logits": logits}
 
 
 def reply_to_dict(reply) -> dict:
@@ -122,7 +126,9 @@ def reply_to_dict(reply) -> dict:
     return {"req_id": None, "status": reply.status, "reason": reply.reason,
             "trace": reply.trace, "retry_after_ms": reply.retry_after_ms,
             "queue_wait_ms": reply.queue_wait_ms,
-            "service_ms": reply.service_ms, "logits": reply.logits}
+            "service_ms": reply.service_ms,
+            "model_version": getattr(reply, "model_version", -1),
+            "logits": reply.logits}
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -373,7 +379,8 @@ class FrontendClient:
                 fut.set_result({"req_id": None, "status": "error",
                                 "reason": "internal", "trace": 0,
                                 "retry_after_ms": 0.0, "queue_wait_ms": 0.0,
-                                "service_ms": 0.0, "logits": None})
+                                "service_ms": 0.0, "model_version": -1,
+                                "logits": None})
 
     def close(self) -> None:
         try:
@@ -413,14 +420,15 @@ class LoopbackClient:
                              "retry_after_ms": getattr(e, "retry_after_ms",
                                                        0.0),
                              "queue_wait_ms": 0.0, "service_ms": 0.0,
-                             "logits": None})
+                             "model_version": -1, "logits": None})
             return done
         except (RuntimeError, ValueError) as e:
             done = Future()
             done.set_result({"req_id": None, "status": "error",
                              "reason": f"internal: {e}", "trace": 0,
                              "retry_after_ms": 0.0, "queue_wait_ms": 0.0,
-                             "service_ms": 0.0, "logits": None})
+                             "service_ms": 0.0, "model_version": -1,
+                             "logits": None})
             return done
         out = Future()
         fut.add_done_callback(

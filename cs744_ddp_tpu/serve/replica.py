@@ -10,10 +10,15 @@ chaos site) takes down exactly its own worker.
 
 Chaos wiring: the scheduler's ``dispatch_hook`` fires this replica's
 sites against its OWN dispatch counter — ``slow_replica:STEP:REPLICA``
-stalls dispatch STEP by ``slow_stall_s`` (a straggler), and
+stalls dispatch STEP by ``slow_stall_s`` (a straggler),
 ``replica_death:STEP:REPLICA`` raises ``ChaosError`` inside the worker,
 exercising the router's failover path (pinned in tests: no accepted
-request is silently dropped).
+request is silently dropped), and ``swap_mid_batch:STEP:REPLICA``
+invokes this replica's weight-watcher probe (``swap_probe``, attached
+by ``publish.WeightWatcher``) INSIDE dispatch STEP's hook — a publish
+racing a dispatch already being assembled.  The probe only queues the
+install, so the racing dispatch is answered bitwise by the OLD weights
+and the next by the new — never a mix (pinned in tests/test_publish.py).
 """
 
 from __future__ import annotations
@@ -42,6 +47,10 @@ class EngineReplica:
         self.index = int(index)
         self.chaos = chaos
         self.slow_stall_s = float(slow_stall_s)
+        # Non-blocking weight-watcher poll (publish.WeightWatcher attaches
+        # it); the swap_mid_batch chaos site calls it inside the dispatch
+        # hook to race a publish against a live dispatch.
+        self.swap_probe = None
         self.engine = InferenceEngine(
             model, buckets=buckets, precisions=(precision,), state=state,
             seed=seed, telemetry=tel, cache_dir=cache_dir, device=device,
@@ -64,6 +73,11 @@ class EngineReplica:
                 and ch.seed_of("slow_replica", dispatch_no) == self.index \
                 and ch.fire("slow_replica", dispatch_no):
             time.sleep(self.slow_stall_s)
+        if dispatch_no in ch.steps("swap_mid_batch") \
+                and ch.seed_of("swap_mid_batch", dispatch_no) == self.index \
+                and ch.fire("swap_mid_batch", dispatch_no) \
+                and self.swap_probe is not None:
+            self.swap_probe()
         if dispatch_no in ch.steps("replica_death") \
                 and ch.seed_of("replica_death", dispatch_no) == self.index \
                 and ch.fire("replica_death", dispatch_no):

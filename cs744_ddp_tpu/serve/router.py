@@ -148,6 +148,8 @@ class ReplicaRouter:
             "replicas": [{
                 "replica": s.replica,
                 "alive": s.alive,
+                "weights_version": int(getattr(
+                    getattr(s, "engine", None), "weights_version", -1)),
                 "outstanding_s": round(s.outstanding_s(), 6),
                 "svc_ms": {b: round(s.svc.predict(b) * 1e3, 4)
                            for b in s.buckets},

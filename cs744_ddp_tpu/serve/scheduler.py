@@ -80,6 +80,9 @@ class Reply(NamedTuple):
     service_ms: float = 0.0
     latency_ms: float = 0.0
     replica: int = -1
+    # Engine weights_version that served this request (publish/ hot-swap
+    # A/B pin); -1 for replies that never reached a dispatch (shed/error).
+    model_version: int = -1
 
 
 class Admission(NamedTuple):
@@ -409,7 +412,7 @@ class SLOScheduler:
     """
 
     _lock_owned = ("_pending", "_pending_images", "_inflight", "_stop",
-                   "_dead", "_busy_s", "_worker", "_t0_wall")
+                   "_dead", "_busy_s", "_worker", "_t0_wall", "_installs")
 
     def __init__(self, engine, *, svc: Optional[ServiceModel] = None,
                  shed: bool = True, max_queue_images: int = 1024,
@@ -435,6 +438,10 @@ class SLOScheduler:
         self._worker: Optional[threading.Thread] = None
         self._t0_wall: Optional[float] = None
         self._dispatches = 0          # worker-thread-local dispatch index
+        # Engine-free-instant work queue (weight installs): closures the
+        # worker runs at its next loop boundary, when no dispatch is in
+        # flight — the hot-swap's no-torn-reads guarantee.
+        self._installs: List[Tuple[Callable[[], object], Future]] = []
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -461,6 +468,13 @@ class SLOScheduler:
             self._cond.notify_all()
         if worker is not None:
             worker.join()
+        # Installs queued after the worker's last boundary check would be
+        # stranded — run them inline (the worker is gone, so this thread
+        # IS the engine-free instant).
+        with self._cond:
+            leftovers = self._installs
+            self._installs = []
+        self._run_installs(leftovers)
         t_now = time.time()
         with self._cond:
             self._worker = None
@@ -521,6 +535,41 @@ class SLOScheduler:
             tel.counter("serve_admitted", tier=req.tier, replica=self.replica)
         return req.future
 
+    def request_install(self, fn: Callable[[], object]) -> Future:
+        """Queue ``fn`` (a weight-flip closure from the publish watcher)
+        to run at the worker's next engine-free instant — between
+        dispatches, so no batch can observe a torn weight tree.  Returns
+        a Future resolving to ``fn()``'s result (or its exception).
+
+        With no live worker (not started, stopped, or dead) there is no
+        dispatcher to race, so ``fn`` runs inline right here.  Safe to
+        call from inside a dispatch hook (the ``swap_mid_batch`` chaos
+        probe): the hook runs ON the worker thread, the install is merely
+        queued, and it lands after the current dispatch completes — the
+        caller must not block on the Future from that context.
+        """
+        fut: Future = Future()
+        inline = False
+        with self._cond:
+            if self._worker is None or self._dead or self._stop:
+                inline = True
+            else:
+                self._installs.append((fn, fut))
+                self._cond.notify_all()
+        if inline:
+            self._run_installs([(fn, fut)])
+        return fut
+
+    @staticmethod
+    def _run_installs(installs) -> None:
+        for fn, fut in installs:
+            if fut.done():
+                continue
+            try:
+                fut.set_result(fn())
+            except Exception as exc:   # install failure must not kill serving
+                fut.set_exception(exc)
+
     def _retry_hint_ms_locked(self, n: int) -> float:
         """Time for the backlog to drain enough to admit ``n`` more images
         (queue depth x per-max-bucket service-time estimate).  Caller
@@ -559,22 +608,31 @@ class SLOScheduler:
             self._die(exc)
 
     def _next_admission(self):
-        with self._cond:
-            while True:
-                if self._pending:
-                    now = time.time()
-                    adm = admit(self._pending, now, buckets=self.buckets,
-                                predict_s=self.svc.predict, shed=self.shed)
-                    taken = {id(r) for r in adm.batch}
-                    taken.update(id(r) for r, _ in adm.shed)
-                    self._pending = [r for r in self._pending
-                                     if id(r) not in taken]
-                    self._pending_images = sum(r.n for r in self._pending)
-                    self._inflight = adm.batch
-                    return adm, now
-                if self._stop:
-                    return None
-                self._cond.wait()
+        while True:
+            with self._cond:
+                installs = self._installs
+                self._installs = []
+                if not installs:
+                    if self._pending:
+                        now = time.time()
+                        adm = admit(self._pending, now, buckets=self.buckets,
+                                    predict_s=self.svc.predict,
+                                    shed=self.shed)
+                        taken = {id(r) for r in adm.batch}
+                        taken.update(id(r) for r, _ in adm.shed)
+                        self._pending = [r for r in self._pending
+                                         if id(r) not in taken]
+                        self._pending_images = sum(r.n for r in self._pending)
+                        self._inflight = adm.batch
+                        return adm, now
+                    if self._stop:
+                        return None
+                    self._cond.wait()
+                    continue
+            # Engine-free instant: no dispatch in flight, lock released
+            # (an install may device_put / take its time — admission and
+            # enqueue must not stall behind it).
+            self._run_installs(installs)
 
     def _resolve_shed(self, shed, now: float) -> None:
         tel = self.telemetry
@@ -601,12 +659,22 @@ class SLOScheduler:
     def _dispatch(self, batch, bucket: int) -> None:
         tel = self.telemetry
         hook = self.dispatch_hook
+        # The service clock starts BEFORE the dispatch hook: a hook stall
+        # (``slow_replica`` — a straggling chip) is service time the
+        # router's EWMA must learn, not queue wait.
+        t0 = time.time()
         if hook is not None:
             hook(self._dispatches, bucket)
         self._dispatches += 1
+        # The version serving THIS batch, read once at dispatch.  Installs
+        # only land at loop boundaries (never mid-dispatch), so the value
+        # read here is exactly the weights the executable will consume —
+        # the per-request A/B pin.  A swap_mid_batch probe fired by the
+        # hook above only QUEUES an install; this batch still runs (and is
+        # tagged) on the old weights.
+        version = int(getattr(self.engine, "weights_version", -1))
         images, labels = self._assemble(batch)
         traces = tuple(r.trace for r in batch)
-        t0 = time.time()
         if tel.enabled:
             logits, _, _ = self.engine.infer_counts(
                 images, labels, precision=self.precision, trace_ids=traces)
@@ -642,7 +710,7 @@ class SLOScheduler:
                     status="ok" if met else "late", trace=r.trace,
                     tier=r.tier, logits=out, queue_wait_ms=qw_ms,
                     service_ms=round(svc_s * 1e3, 3), latency_ms=lat_ms,
-                    replica=self.replica))
+                    replica=self.replica, model_version=version))
 
     def _die(self, exc: Exception) -> None:
         with self._cond:
@@ -652,7 +720,13 @@ class SLOScheduler:
             self._inflight = ()
             self._pending = []
             self._pending_images = 0
+            installs = self._installs
+            self._installs = []
             self._cond.notify_all()
+        for _, fut in installs:        # a dead replica installs nothing
+            if not fut.done():
+                fut.set_exception(RuntimeError(
+                    f"replica {self.replica} died before install: {exc}"))
         if self.telemetry.enabled:
             self.telemetry.counter("replica_dead", replica=self.replica,
                                    error=type(exc).__name__)

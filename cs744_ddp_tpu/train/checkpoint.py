@@ -72,6 +72,23 @@ def _atomic_write_json(path: str, obj) -> None:
             os.unlink(tmp)
 
 
+# The identity keys a published weight bundle carries (publish/): enough
+# for the serving side to refuse a bundle from the wrong run/architecture,
+# none of the training-only knobs (lr, augment, ...) that don't affect
+# what the weights ARE.
+_PUBLISH_FINGERPRINT_KEYS = ("model", "strategy", "precision", "seed",
+                             "global_batch", "state_digest")
+
+
+def publish_fingerprint(config: dict) -> dict:
+    """Model/config identity stamped into published weight bundles —
+    the same fields the checkpoint config guard validates, plus the
+    state-format stamp."""
+    fp = {k: config[k] for k in _PUBLISH_FINGERPRINT_KEYS if k in config}
+    fp.setdefault("state_format_version", STATE_FORMAT_VERSION)
+    return fp
+
+
 # Config keys an ELASTIC resume is allowed to change: the whole point of
 # the elastic layer is resuming at a different world size (and, under weak
 # scaling, a rescaled global batch) — see cs744_ddp_tpu/elastic/.

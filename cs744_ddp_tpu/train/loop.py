@@ -2002,7 +2002,9 @@ class Trainer:
 
     def run(self, epochs: int = 1,
             checkpoint_dir: Optional[str] = None,
-            profile_dir: Optional[str] = None) -> None:
+            profile_dir: Optional[str] = None,
+            publish_dir: Optional[str] = None,
+            publish_every: int = 1) -> None:
         """The reference's run(): epochs of train + eval with epoch timing.
 
         With ``checkpoint_dir`` set, resumes from the latest saved epoch (if
@@ -2023,7 +2025,14 @@ class Trainer:
         from that exact step — every PRNG fold and the sampler are keyed by
         (seed, epoch, absolute step), so the interrupted+resumed run is
         bitwise identical to an uninterrupted one (pinned by
-        tests/test_ft.py)."""
+        tests/test_ft.py).
+
+        With ``publish_dir`` set, the serving half of the state (params +
+        BatchNorm stats) is published as a versioned, crc-checksummed
+        weight bundle every ``publish_every`` completed epochs — the
+        train side of the publish/ hot-swap loop: a live serving process
+        watching that directory installs each version between dispatches
+        without restarts or recompiles (see cs744_ddp_tpu/publish/)."""
         start_epoch = 0
         start_step = 0
         mngr = None
@@ -2079,6 +2088,26 @@ class Trainer:
             if self._nf_policy == "restore" and \
                     (mid is not None or le is not None):
                 self._snapshot_rollback()   # rollback point = restored state
+        publisher = None
+        if publish_dir is not None:
+            if publish_every < 1:
+                raise ValueError(f"publish_every must be >= 1, "
+                                 f"got {publish_every}")
+            from ..publish import WeightPublisher
+            from .checkpoint import publish_fingerprint
+            digest_state = self.state._replace(
+                opt_state=self.state.opt_state._replace(comm=None))
+            param_tree = jax.tree.map(
+                lambda a: f"{a.dtype}{list(a.shape)}", digest_state)
+            publisher = WeightPublisher(
+                publish_dir,
+                fingerprint=publish_fingerprint({
+                    "model": self.model_name,
+                    "strategy": self.strategy_name,
+                    "seed": self.seed, "precision": self.precision,
+                    "global_batch": self.global_batch,
+                    "state_digest": str(param_tree)}),
+                telemetry=self.telemetry, chaos=self.chaos)
         try:
             if mngr is not None or self._supervise:
                 self._preempt_guard = PreemptionGuard(log=self.log).install()
@@ -2149,6 +2178,13 @@ class Trainer:
                     mngr.clear_mid_epoch()
                     if self._nf_policy == "restore":
                         self._snapshot_rollback()   # advance rollback point
+                if publisher is not None \
+                        and (epoch + 1) % publish_every == 0:
+                    with self.telemetry.span("publish", epoch=epoch):
+                        rec = publisher.publish(self.state)
+                    self.log(f"Published weights v{rec['version']} "
+                             f"({rec['bytes']} B, {rec['leaves']} leaves) "
+                             f"to {publish_dir}")
                 if self._preempt_guard is not None and \
                         self._preempt_guard.requested:
                     # The signal landed during eval/save: the epoch boundary

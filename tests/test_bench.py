@@ -134,6 +134,30 @@ def test_bench_matrix_and_sweep_wellformed(tmp_path, monkeypatch):
     assert sv["latency"]["50rps"]["completed"] > 0
     assert "serve_dispatch" in sv["telemetry_summary"]["spans"]
 
+    # Hot-swap section (round 10): a steady row plus rolling/all-at-once
+    # swap rows replaying the SAME trace while bundles land mid-stream.
+    # Full swap behavior (A/B pin, torn rejection) is pinned in
+    # tests/test_publish.py; here the subject is the section's shape and
+    # its two CI contracts — every request answered and ZERO recompiles.
+    hw = result["hotswap"]
+    assert hw["model"] == "servenet" and hw["replicas"] == 2
+    assert hw["steady"]["replies"] > 0 and hw["steady"]["unresolved"] == 0
+    for name in ("rolling", "all_at_once"):
+        row = hw[name]
+        assert row["rolling"] is (name == "rolling")
+        assert row["installs"] == row["publishes"] == 3
+        assert row["installed_version"] == 3
+        assert set(row["weights_versions"]) == {3}
+        assert row["swap_samples"] == 3 * hw["replicas"]
+        assert 0 < row["swap_ms_p50"] <= row["swap_ms_p99"] \
+            <= row["swap_ms_max"]
+        assert len(row["in_flight_at_publish"]) == 3
+        assert row["recompiles"] == 0
+        assert row["replies"] == hw["steady"]["replies"]
+        assert row["unresolved"] == 0
+        assert isinstance(row["goodput_dip_pct"], float)  # noise can be <0
+    assert hw["zero_recompiles"] is True
+
     # Compression section (round 7): per-tier measured wall-clock, static
     # comm bytes from the audited lowering, and convergence delta vs the
     # uncompressed allreduce baseline.
@@ -362,15 +386,21 @@ def test_emit_head_budget_worst_case_with_serving(tmp_path):
 
 
 def test_emit_head_budget_with_committed_serving_load(tmp_path):
-    """Round 9: the committed BENCH_FULL.json now carries the fat
+    """Rounds 9/10: the committed BENCH_FULL.json now carries the fat
     ``serving_load`` section (replica-scaling rows, goodput curve,
-    overload telemetry summary).  Re-emitting that REAL artifact must
-    still produce a final stdout line within the driver budget — the
-    new section rides in the sidecar, never the head."""
+    overload telemetry summary) and the ``hotswap`` section (swap
+    latency, in-flight samples, goodput dip).  Re-emitting that REAL
+    artifact must still produce a final stdout line within the driver
+    budget — the new sections ride in the sidecar, never the head."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with open(os.path.join(repo, "BENCH_FULL.json")) as f:
         result = json.load(f)
     assert "serving_load" in result
+    assert "hotswap" in result
+    # The committed swap rows honor the section's two CI contracts.
+    assert result["hotswap"]["zero_recompiles"] is True
+    for name in ("rolling", "all_at_once"):
+        assert result["hotswap"][name]["unresolved"] == 0
     lines = []
     head = bench.emit_result(result, str(tmp_path / "FULL.json"),
                              out=lines.append)
@@ -379,6 +409,7 @@ def test_emit_head_budget_with_committed_serving_load(tmp_path):
     parsed = json.loads(final)
     assert parsed == head
     assert "serving_load" not in parsed
+    assert "hotswap" not in parsed
     assert json.loads((tmp_path / "FULL.json").read_text()) == result
 
 

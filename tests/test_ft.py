@@ -27,8 +27,8 @@ import pytest
 import cs744_ddp_tpu.train.loop as looplib
 from cs744_ddp_tpu.data import cifar10
 from cs744_ddp_tpu.elastic import ElasticCoordinator
-from cs744_ddp_tpu.ft import (NULL_CHAOS, RANK_SITES, SITES, ChaosPlan,
-                              FTConfig, NonFiniteError, NullChaos,
+from cs744_ddp_tpu.ft import (NULL_CHAOS, PUBLISH_SITES, RANK_SITES, SITES,
+                              ChaosPlan, FTConfig, NonFiniteError, NullChaos,
                               RankDeathError, StagingStalled, Watchdog,
                               batch_checksums, call_with_retry,
                               verify_checksums)
@@ -784,3 +784,114 @@ def test_slow_rank_flags_straggler_and_stream_unchanged(tmp_path, mesh4,
     assert tr._straggler.flag_counts.get(2, 0) >= 1
     assert tr.rank_death is None
     _assert_bitwise(_host_state(tr), clean)
+
+
+# -- publish/hot-swap chaos sites (round 10) ----------------------------------
+
+
+def test_chaos_publish_and_swap_sites_one_shot_seeded():
+    assert PUBLISH_SITES == ("publish_torn", "publish_stale")
+    assert "swap_mid_batch" in SITES
+    assert all(s in SITES for s in PUBLISH_SITES)
+    plan = ChaosPlan.parse(["publish_torn:1:7", "publish_stale:2",
+                            "swap_mid_batch:4:1"])
+    # The third field targets a replica for swap_mid_batch — carried in
+    # the seed slot, same convention as the rank/replica sites.
+    assert plan.seed_of("swap_mid_batch", 4) == 1
+    assert not plan.fire("publish_torn", 0)
+    assert plan.fire("publish_torn", 1)
+    assert not plan.fire("publish_torn", 1)            # one-shot
+    assert plan.fire("publish_stale", 2)
+    assert plan.fired == [("publish_torn", 1), ("publish_stale", 2)]
+    # Torn-byte offsets are deterministic in (seed, site, step).
+    a = ChaosPlan.parse(["publish_torn:1:7"]).rng("publish_torn", 1)
+    b = ChaosPlan.parse(["publish_torn:1:7"]).rng("publish_torn", 1)
+    np.testing.assert_array_equal(a.integers(0, 2**31, size=8),
+                                  b.integers(0, 2**31, size=8))
+
+
+def _publish_stack(tmp_path, chaos):
+    """Minimal publish->serve loop: one publisher, one CPU replica, one
+    watcher (probes attached) — the recovery-pin fixture for the three
+    round-10 chaos sites."""
+    from cs744_ddp_tpu import models as model_zoo
+    from cs744_ddp_tpu.publish import WeightPublisher, WeightWatcher
+    from cs744_ddp_tpu.serve import EngineReplica
+    model_zoo.register_model("tiny", tiny_cnn)
+    pub = WeightPublisher(str(tmp_path / "pub"), chaos=chaos,
+                          fingerprint={"model": "tiny"})
+    replica = EngineReplica(0, model="tiny", buckets=(2,), seed=0,
+                            chaos=chaos)
+    replica.startup()
+    watcher = WeightWatcher(pub.directory, [replica])
+    return pub, replica, watcher
+
+
+def _tiny_state(seed):
+    from cs744_ddp_tpu.train.step import init_train_state
+    init_fn, _ = tiny_cnn()
+    return init_train_state(init_fn, jax.random.PRNGKey(seed))
+
+
+def test_publish_torn_rejected_by_crc_old_version_serves(tmp_path):
+    """publish_torn recovery pin: the torn bundle (seeded payload bytes
+    flipped after the atomic rename) is rejected at crc-verify time and
+    the previously installed version keeps serving bitwise-unchanged."""
+    plan = ChaosPlan.parse(["publish_torn:1"])
+    pub, replica, watcher = _publish_stack(tmp_path, plan)
+    assert pub.publish(_tiny_state(1))["torn"] is False
+    assert watcher.poll_once() == "installed"
+    imgs = cifar10._synthetic_split(8, seed=5).images[:2]
+    before, _, _ = replica.engine.infer_counts(imgs)
+    rec = pub.publish(_tiny_state(2))
+    assert rec["torn"] is True and ("publish_torn", 1) in plan.fired
+    assert watcher.poll_once() == "rejected"
+    rep = watcher.report()
+    assert rep["rejected"] == 1 and rep["installed_version"] == 1
+    assert replica.engine.weights_version == 1
+    after, _, _ = replica.engine.infer_counts(imgs)
+    np.testing.assert_array_equal(np.asarray(after), np.asarray(before))
+
+
+def test_publish_stale_skipped_current_version_keeps_serving(tmp_path):
+    """publish_stale recovery pin: a duplicate publisher re-announcing an
+    already-installed version is skipped — never re-installed, never an
+    error, the current version keeps serving."""
+    plan = ChaosPlan.parse(["publish_stale:1"])
+    pub, replica, watcher = _publish_stack(tmp_path, plan)
+    assert pub.publish(_tiny_state(1))["version"] == 1
+    assert watcher.poll_once() == "installed"
+    rec = pub.publish(_tiny_state(2))
+    assert rec["stale"] is True and rec["version"] == 1
+    assert rec["file"].endswith(".dup.ccwb")
+    assert ("publish_stale", 1) in plan.fired
+    assert watcher.poll_once() == "stale"
+    rep = watcher.report()
+    assert rep["stale"] == 1 and rep["installed_version"] == 1
+    assert replica.engine.weights_version == 1
+
+
+def test_swap_mid_batch_probe_never_mixes_weights(tmp_path):
+    """swap_mid_batch recovery pin: chaos fires the watcher's poll from
+    INSIDE dispatch 1's hook on the scheduler worker thread; the racing
+    dispatch is answered ENTIRELY by the old weights (the flip lands at
+    the next loop boundary) and the next dispatch by the new — a batch
+    never sees mixed weights, and every reply's model_version tag says
+    which model computed it."""
+    plan = ChaosPlan.parse(["swap_mid_batch:1:0"])
+    pub, replica, watcher = _publish_stack(tmp_path, plan)
+    pub.publish(_tiny_state(1))
+    assert watcher.poll_once() == "installed"
+    imgs = cifar10._synthetic_split(8, seed=5).images[:2]
+    replica.start()
+    try:
+        r0 = replica.scheduler.submit(imgs, slo_ms=None).result(30.0)
+        pub.publish(_tiny_state(2))   # v2 on disk; only the probe polls
+        r1 = replica.scheduler.submit(imgs, slo_ms=None).result(30.0)
+        r2 = replica.scheduler.submit(imgs, slo_ms=None).result(30.0)
+    finally:
+        replica.stop()
+    assert ("swap_mid_batch", 1) in plan.fired
+    assert (r0.model_version, r1.model_version, r2.model_version) == (1, 1, 2)
+    np.testing.assert_array_equal(r1.logits, r0.logits)   # old model, whole batch
+    assert not np.array_equal(r2.logits, r1.logits)       # new model after flip

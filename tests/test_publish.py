@@ -1,0 +1,405 @@
+"""Train-to-serve weight hot-swap tests (round 10, cs744_ddp_tpu/publish/).
+
+The pins, mirroring the ISSUE's acceptance bar:
+
+* The CCWB1 bundle round-trips bitwise, and every corruption class —
+  flipped payload byte, truncation, trailing garbage, bad magic, torn
+  LATEST pointer — is rejected with the failing leaf named: no torn
+  bundle is ever installable.
+* The publisher is atomic and monotonic: bundle file first, LATEST
+  pointer last, versions continue an existing directory's sequence
+  across publisher restarts, no tmp litter.
+* The watcher validates against each ENGINE's abstract signature (a
+  drifted pytree or a wrong-model fingerprint is rejected BEFORE any
+  replica is touched — a bad bundle can never desync the AOT ladder).
+* The bitwise A/B pin, end to end: train an epoch, publish v1, serve;
+  train another epoch, publish v2 mid-serve; every reply's logits are
+  bitwise what its tagged model_version computes, requests dispatched
+  pre-swap are answered by the old model and post-swap by the new, with
+  zero drops, zero duplicate replies, and ZERO recompiles (the
+  executable-cache size is unchanged across the swap).
+* The wire codec carries model_version end to end (absent -> -1).
+* The audit's swap re-certification rung catches a planted baked
+  weight: an engine that folds installed weights into its programs must
+  fail ``serve_swap/*`` on the baked-constants rule.
+* tools/telemetry_report.py renders the ``== publish ==`` section from
+  both sides' counters/gauges, absent-safe for runs without publishes.
+
+The chaos-site recovery pins (publish_torn / publish_stale /
+swap_mid_batch) live in tests/test_ft.py with the other per-site pins.
+"""
+
+import os
+
+import numpy as np
+
+import jax
+import pytest
+
+from cs744_ddp_tpu import models as model_zoo
+from cs744_ddp_tpu.data import cifar10
+from cs744_ddp_tpu.publish import (BundleError, WeightPublisher,
+                                   WeightWatcher, bundle_nbytes,
+                                   leaf_signature, read_bundle, read_latest,
+                                   read_manifest, write_bundle)
+from cs744_ddp_tpu.serve import EngineReplica, InferenceEngine, ReplicaRouter
+from cs744_ddp_tpu.serve.frontend import decode_reply, encode_reply
+from cs744_ddp_tpu.train.loop import Trainer
+from cs744_ddp_tpu.train.step import init_train_state
+
+from tinynet import tiny_cnn, tiny_cnn_nobn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def setup_module(module):
+    model_zoo.register_model("tiny", tiny_cnn)
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return cifar10._synthetic_split(64, seed=5)
+
+
+def _state(seed):
+    init_fn, _ = tiny_cnn()
+    return init_train_state(init_fn, jax.random.PRNGKey(seed))
+
+
+def _leaves():
+    return [np.arange(12, dtype=np.float32).reshape(3, 4),
+            np.array([1, -2], dtype=np.int32)]
+
+
+# -- bundle container ---------------------------------------------------------
+
+
+def test_bundle_roundtrip_bitwise(tmp_path):
+    leaves = _leaves()
+    path = str(tmp_path / "b.ccwb")
+    write_bundle(path, leaves, version=3, treedef="TD",
+                 fingerprint={"model": "tiny"})
+    man, out = read_bundle(path)
+    assert man["version"] == 3 and man["treedef"] == "TD"
+    assert man["fingerprint"] == {"model": "tiny"}
+    assert bundle_nbytes(man) == sum(l.nbytes for l in leaves)
+    assert leaf_signature(out) == leaf_signature(leaves)
+    for a, b in zip(leaves, out):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b)
+
+
+def test_bundle_rejects_every_corruption_class(tmp_path):
+    path = str(tmp_path / "b.ccwb")
+
+    def fresh():
+        write_bundle(path, _leaves(), version=1, treedef="TD")
+        return os.path.getsize(path)
+
+    # One flipped byte in the LAST leaf's payload: crc fails, leaf named.
+    size = fresh()
+    with open(path, "r+b") as f:
+        f.seek(size - 1)
+        b = f.read(1)
+        f.seek(size - 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(BundleError, match="leaf 1 crc32 mismatch"):
+        read_bundle(path)
+    # ... but the manifest alone still parses (staleness peek stays cheap).
+    assert read_manifest(path)["version"] == 1
+
+    # Truncation mid-payload: the short leaf is named.
+    size = fresh()
+    with open(path, "r+b") as f:
+        f.truncate(size - 4)
+    with pytest.raises(BundleError, match="leaf 1 truncated"):
+        read_bundle(path)
+
+    # Trailing garbage after the last leaf.
+    fresh()
+    with open(path, "ab") as f:
+        f.write(b"x")
+    with pytest.raises(BundleError, match="trailing bytes"):
+        read_bundle(path)
+
+    # Bad magic.
+    fresh()
+    with open(path, "r+b") as f:
+        f.write(b"Z")
+    with pytest.raises(BundleError, match="bad magic"):
+        read_bundle(path)
+
+    # Torn/malformed LATEST pointer (written atomically, so a malformed
+    # one is a real fault, not a race).
+    (tmp_path / "LATEST").write_text("{not json")
+    with pytest.raises(BundleError, match="malformed LATEST"):
+        read_latest(str(tmp_path))
+    (tmp_path / "LATEST").write_text('{"version": 1}')
+    with pytest.raises(BundleError, match="missing version/file"):
+        read_latest(str(tmp_path))
+
+
+def test_publisher_monotonic_versions_latest_last(tmp_path):
+    d = str(tmp_path / "pub")
+    assert read_latest(d) is None if os.path.isdir(d) else True
+    pub = WeightPublisher(d, fingerprint={"model": "tiny"})
+    r1 = pub.publish(_state(1))
+    r2 = pub.publish(_state(2))
+    assert (r1["version"], r2["version"]) == (1, 2)
+    latest = read_latest(d)
+    assert latest == {"version": 2, "file": "v000002.ccwb"}
+    # A restarted publisher continues the sequence — never re-issues v1.
+    assert WeightPublisher(d).publish(_state(3))["version"] == 3
+    # tmp+rename left no litter, and both early bundles verify in full.
+    assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+    man = read_manifest(os.path.join(d, "v000001.ccwb"))
+    assert man["version"] == 1 and man["fingerprint"]["model"] == "tiny"
+    read_bundle(os.path.join(d, "v000002.ccwb"))
+
+
+# -- validation: engine signature is the gate ---------------------------------
+
+
+def test_engine_install_weights_validates_abstract_signature():
+    engine = InferenceEngine("tiny", buckets=(2,), seed=0)
+    init_fn, _ = tiny_cnn_nobn()
+    alien = init_train_state(init_fn, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="abstract"):
+        engine.install_weights(alien.params, alien.bn_state, 1)
+    assert engine.weights_version == 0
+
+
+def test_watcher_rejects_mismatched_bundle(tmp_path):
+    replica = EngineReplica(0, model="tiny", buckets=(2,), seed=0)
+    replica.startup()
+    # A different ARCHITECTURE's weights (the no-BN variant): pytree
+    # drift, rejected against the engine's abstract signature.
+    d = str(tmp_path / "pub")
+    init_fn, _ = tiny_cnn_nobn()
+    alien = init_train_state(init_fn, jax.random.PRNGKey(0))
+    WeightPublisher(d).publish(alien)
+    watcher = WeightWatcher(d, [replica])
+    assert watcher.poll_once() == "rejected"
+    assert watcher.report()["rejected"] == 1
+    assert replica.engine.weights_version == 0
+    # The right weights under the wrong model fingerprint: also rejected
+    # before any replica is touched.
+    d2 = str(tmp_path / "pub2")
+    WeightPublisher(d2, fingerprint={"model": "vgg11"}).publish(_state(1))
+    watcher2 = WeightWatcher(d2, [replica])
+    assert watcher2.poll_once() == "rejected"
+    assert replica.engine.weights_version == 0
+
+
+# -- wire protocol ------------------------------------------------------------
+
+
+def test_wire_codec_roundtrips_model_version():
+    logits = np.arange(10, dtype=np.float32).reshape(1, 10)
+    rep = decode_reply(encode_reply(5, {
+        "status": "ok", "trace": 9, "logits": logits, "reason": "",
+        "queue_wait_ms": 1.0, "service_ms": 2.0, "retry_after_ms": 0.0,
+        "model_version": 7}))
+    assert rep["model_version"] == 7
+    assert np.array_equal(rep["logits"], logits)
+    # Replies minted before any install (or error paths) carry -1.
+    rep2 = decode_reply(encode_reply(6, {
+        "status": "error", "trace": 0, "logits": None, "reason": "x",
+        "queue_wait_ms": 0.0, "service_ms": 0.0, "retry_after_ms": 0.0}))
+    assert rep2["model_version"] == -1
+
+
+# -- trainer integration ------------------------------------------------------
+
+
+def _mini_trainer(tmp_path, seed=3):
+    return Trainer(model="tiny", strategy="single", num_devices=1,
+                   global_batch=64, data_dir=str(tmp_path), seed=seed,
+                   limit_train_batches=2, limit_eval_batches=1,
+                   log=lambda s: None)
+
+
+def test_trainer_publishes_every_k_epochs(tmp_path):
+    pub_dir = str(tmp_path / "pub")
+    tr = _mini_trainer(tmp_path)
+    tr.run(2, publish_dir=pub_dir, publish_every=2)
+    latest = read_latest(pub_dir)
+    assert latest["version"] == 1          # one publish, after epoch 2
+    man = read_manifest(os.path.join(pub_dir, latest["file"]))
+    fp = man["fingerprint"]
+    assert fp["model"] == "tiny" and fp["global_batch"] == 64
+    assert fp["seed"] == 3 and "state_digest" in fp
+    assert "state_format_version" in fp
+    with pytest.raises(ValueError, match="publish_every"):
+        tr.run(1, publish_dir=pub_dir, publish_every=0)
+
+
+# -- the bitwise A/B pin, end to end ------------------------------------------
+
+
+def _install_version(engine, pub_dir, version):
+    """Install bundle ``version`` into a reference engine through the
+    same entry point a live swap uses."""
+    _, leaves = read_bundle(os.path.join(pub_dir, f"v{version:06d}.ccwb"))
+    _, treedef = jax.tree_util.tree_flatten((engine.params,
+                                             engine.bn_state))
+    params, bn_state = jax.tree_util.tree_unflatten(treedef, leaves)
+    engine.install_weights(params, bn_state, version)
+
+
+def test_hot_swap_ab_pin_end_to_end(tmp_path, pool):
+    pub_dir = str(tmp_path / "pub")
+    tr = _mini_trainer(tmp_path)
+    tr.run(1, publish_dir=pub_dir)                    # trains + publishes v1
+    assert read_latest(pub_dir)["version"] == 1
+
+    replicas = [EngineReplica(i, model="tiny", buckets=(2, 4), seed=0)
+                for i in range(2)]
+    for r in replicas:
+        r.startup()
+    watcher = WeightWatcher(pub_dir, replicas)
+    assert watcher.poll_once() == "installed"
+    exec_sizes = [len(r.engine._exec) for r in replicas]
+
+    router = ReplicaRouter(replicas)
+    with router:
+        pre = [(pool.images[2 * i:2 * i + 2],
+                router.submit(pool.images[2 * i:2 * i + 2], slo_ms=None))
+               for i in range(6)]
+        pre = [(imgs, f.result(30.0)) for imgs, f in pre]
+        tr.run(1, publish_dir=pub_dir)                # epoch 2 -> publishes v2
+        assert read_latest(pub_dir)["version"] == 2
+        assert watcher.poll_once() == "installed"     # flips at boundaries
+        post = [(pool.images[2 * i:2 * i + 2],
+                 router.submit(pool.images[2 * i:2 * i + 2], slo_ms=None))
+                for i in range(6, 12)]
+        post = [(imgs, f.result(30.0)) for imgs, f in post]
+
+    replies = pre + post
+    # No drops, no duplicates: 12 requests, 12 ok replies, 12 traces.
+    assert [r.status for _, r in replies] == ["ok"] * 12
+    assert len({r.trace for _, r in replies}) == 12
+    # The A/B pin's ordering half: dispatched pre-swap -> old model,
+    # post-swap -> new, per-request via the model_version tag.
+    assert [r.model_version for _, r in pre] == [1] * 6
+    assert [r.model_version for _, r in post] == [2] * 6
+    # Zero recompiles: the executable caches did not grow.
+    assert [len(r.engine._exec) for r in replicas] == exec_sizes
+    assert watcher.report()["installed_version"] == 2
+
+    # The bitwise half: every reply matches what its TAGGED version
+    # computes on the same images, via a reference engine fed each
+    # bundle through the same install entry point.
+    ref = InferenceEngine("tiny", buckets=(2, 4), seed=0)
+    probe = {}
+    for v in (1, 2):
+        _install_version(ref, pub_dir, v)
+        probe[v] = np.asarray(ref.infer_counts(pool.images[:2])[0])
+        for imgs, r in replies:
+            if r.model_version == v:
+                want, _, _ = ref.infer_counts(imgs)
+                np.testing.assert_array_equal(r.logits, np.asarray(want))
+    # The swap is observable: v1 and v2 genuinely answer differently.
+    assert not np.array_equal(probe[1], probe[2])
+
+
+# -- audit: swap path re-certified weight-agnostic ----------------------------
+
+
+_BAKED = """\
+HloModule {name}
+
+ENTRY main {{
+  c = f32[{n}]{{0}} constant({{...}})
+  p = f32[{n}]{{0}} parameter(0)
+  ROOT o = f32[{n}]{{0}} add(c, p)
+}}
+"""
+
+
+class _BakingEngine:
+    """Simulates the failure mode the swap-recert rung exists to catch:
+    an engine that FOLDS installed weights into its programs as
+    constants (so a swap would silently keep serving stale weights)."""
+
+    buckets = (2,)
+    model_name = "tiny"
+    weights_version = 1
+
+    def __init__(self):
+        init_fn, _ = tiny_cnn()
+        self.params, self.bn_state = init_fn(jax.random.PRNGKey(0))
+        self._baked = False
+
+    def install_weights(self, params, bn_state, version, **kw):
+        self.params, self.bn_state = params, bn_state
+        self.weights_version = int(version)
+        self._baked = True
+
+    def lowered_hlo(self, b, precision):
+        # Pre-swap: a small (legitimate) constant.  Post-install: 1.6 MB
+        # of baked weights, over the 1 MiB contract.
+        n = 400000 if self._baked else 1000
+        return _BAKED.format(name=f"serve_b{b}", n=n)
+
+
+def test_audit_swap_recert_catches_baked_weights():
+    from cs744_ddp_tpu.analysis import audit as auditlib
+    eng = _BakingEngine()
+    reports = auditlib.audit_serving(engine=eng, precision="f32",
+                                     swap_recert=True)
+    assert eng._baked and eng.weights_version == 2
+    pre = [r for r in reports if r.program.startswith("serve/")]
+    post = [r for r in reports if r.program.startswith("serve_swap/")]
+    assert pre and all(r.passed for r in pre)
+    assert post and not any(r.passed for r in post)
+    assert {f.rule for r in post for f in r.findings} == {"baked-constants"}
+
+
+def test_audit_swap_recert_real_engine_stays_clean():
+    """The real ladder keeps weights as runtime arguments: the post-swap
+    rungs re-lowered after a genuine install must stay constant-lean."""
+    from cs744_ddp_tpu.analysis import audit as auditlib
+    engine = InferenceEngine("tiny", buckets=(2,), seed=0,
+                             use_staging=False,
+                             enable_compilation_cache=False)
+    reports = auditlib.audit_serving(engine=engine, precision="f32",
+                                     swap_recert=True, swap_seed=9)
+    assert engine.weights_version == 1
+    names = [r.program for r in reports]
+    assert "serve/b2/f32" in names and "serve_swap/b2/f32" in names
+    assert all(r.passed for r in reports)
+
+
+# -- telemetry report ---------------------------------------------------------
+
+
+def test_telemetry_report_publish_section(tmp_path, monkeypatch):
+    """Both sides' publish counters/gauges render as the report's
+    ``== publish ==`` section; runs with no publish signal render
+    without it — absent-safe for older runs."""
+    from cs744_ddp_tpu.obs import Telemetry
+    monkeypatch.syspath_prepend(os.path.join(REPO, "tools"))
+    import telemetry_report
+
+    run = tmp_path / "pubrun"
+    tel = Telemetry(out_dir=str(run))
+    pub = WeightPublisher(str(tmp_path / "pub"), telemetry=tel,
+                          fingerprint={"model": "tiny"})
+    replica = EngineReplica(0, model="tiny", buckets=(2,), seed=0)
+    replica.startup()
+    watcher = WeightWatcher(pub.directory, [replica], telemetry=tel)
+    pub.publish(_state(1))
+    assert watcher.poll_once() == "installed"
+    tel.finalize()
+    text = telemetry_report.render(str(run))
+    assert "== publish (weight hot-swap) ==" in text
+    assert "publish_count" in text and "publish_installed" in text
+    assert "swap latency" in text
+    assert "published 1" in text and "installed 1" in text
+
+    plain = tmp_path / "plain"
+    tel2 = Telemetry(out_dir=str(plain))
+    tel2.step(epoch=0, iter=0, loss=1.0, step_time=0.01)
+    tel2.finalize()
+    assert "== publish" not in telemetry_report.render(str(plain))

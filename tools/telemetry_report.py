@@ -267,6 +267,50 @@ def _slo_lines(events) -> list:
     return lines
 
 
+def _publish_lines(events) -> list:
+    """Weight hot-swap rendering (round 10, ``publish/``): publish/install
+    counters from both sides of the pipeline (publisher counts, installs,
+    crc/signature rejections, stale skips), per-replica swap-latency
+    percentiles from the watcher's ``swap_ms`` gauges, and the last
+    published vs installed version.  Returns [] for runs with no publish
+    signal — older runs render unchanged."""
+    counts = {}
+    swap_ms = []
+    published = installed = None
+    for e in events:
+        kind, name = e.get("kind"), e.get("name")
+        if kind == "counter" and name in (
+                "publish_count", "publish_installed", "publish_rejected",
+                "publish_stale_skipped", "publish_chaos_injected",
+                "weights_installed"):
+            counts[name] = e["total"]
+        elif kind == "gauge" and name == "swap_ms":
+            swap_ms.append(e["value"])
+        elif kind == "gauge" and name == "publish_version":
+            published = e["value"]
+        elif kind == "gauge" and name == "installed_version":
+            installed = e["value"]
+    if not counts and not swap_ms and published is None \
+            and installed is None:
+        return []
+    lines = ["== publish (weight hot-swap) =="]
+    for name in ("publish_count", "publish_installed", "publish_rejected",
+                 "publish_stale_skipped", "publish_chaos_injected",
+                 "weights_installed"):
+        if name in counts:
+            lines.append(f"  {name:<22} {counts[name]}")
+    if published is not None or installed is not None:
+        lines.append(f"  version                published {published}  "
+                     f"installed {installed}")
+    if swap_ms:
+        lines.append(f"  swap latency x{len(swap_ms):<6} "
+                     f"p50 {percentile(swap_ms, 50):8.2f} ms  "
+                     f"p99 {percentile(swap_ms, 99):8.2f} ms  "
+                     f"max {max(swap_ms):8.2f} ms")
+    lines.append("")
+    return lines
+
+
 def render(out_dir: str) -> str:
     manifest, events, summary = read_run(out_dir)
     # A preempted/killed run legitimately truncates the final event line;
@@ -335,6 +379,7 @@ def render(out_dir: str) -> str:
     lines.extend(_attribution_lines(manifest))
     lines.extend(_trace_lines(events))
     lines.extend(_slo_lines(events))
+    lines.extend(_publish_lines(events))
 
     gauges = {}
     for e in events:
