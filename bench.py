@@ -1012,6 +1012,179 @@ def run_serving_load(log, *, model: str = "servenet", buckets=None,
     return out
 
 
+def run_tracing(log, *, model: str = "servenet", buckets=(8, 32),
+                capacity_requests: int = 400, capacity_rps: float = 440.0,
+                capacity_slo_ms: float = 500.0, capacity_repeats: int = 3,
+                subprocess_requests: int = 150,
+                subprocess_rps: float = 120.0,
+                seed: int = 0, precision: str = "f32") -> dict:
+    """Distributed tracing under load (``obs/`` round 12): what the
+    tentpole costs and what it reconstructs.
+
+    * ``capacity`` — the round-9 capacity row (~440 req/s loopback
+      replay) with tracing OFF vs ON (server spans + client root
+      contexts + events.jsonl writes).  The pin: tracing costs <= 5%
+      goodput.  Median of ``capacity_repeats`` runs each way, same
+      seeded trace.
+    * ``two_process`` — the acceptance scenario: a REAL second OS
+      process (tools/serve_load.py replay ``--telemetry-out``) drives
+      the socket front-end; both processes' event streams are merged by
+      ``obs/aggregate.py`` into skew-corrected waterfalls.  Reported:
+      clock-skew estimate (bounded by RTT), complete/orphaned trace
+      counts, the waterfall-sum-vs-client-measured residual, the
+      device-compute join against the HLO cost-model prior, and the
+      aggregation wall clock.
+
+    Standalone-callable, same contract as ``run_serving_load``."""
+    import json as _json
+    import subprocess
+    import tempfile
+    import time as _time
+
+    import jax
+
+    from cs744_ddp_tpu import models
+    from cs744_ddp_tpu.obs import Telemetry, aggregate as _agg
+    from cs744_ddp_tpu.serve import (EngineReplica, FrontendClient,
+                                     LoopbackClient, ReplicaRouter,
+                                     ServingFrontend, demo)
+    from cs744_ddp_tpu.serve.scheduler import cost_model_weights
+
+    log = log or (lambda s: print(s, file=sys.stderr))
+    buckets = tuple(buckets)
+    if model == "servenet":
+        models.register_model("servenet", _servenet_factory)
+    pool = demo.request_pool(seed=seed + 123)
+    sizes = tuple(s for s in demo.SIZE_CHOICES if s <= buckets[-1])
+    trace = demo.synthetic_load_trace(
+        capacity_requests, offered_rps=capacity_rps, seed=seed,
+        size_choices=sizes, tiers=((0, 1, capacity_slo_ms),))
+
+    def _build(telemetry=None):
+        rep = EngineReplica(0, model=model, buckets=buckets,
+                            precision=precision, seed=seed,
+                            telemetry=telemetry, cost_prior=True)
+        rep.startup()
+        return rep
+
+    def _goodput(rep, telemetry_client=None):
+        router = ReplicaRouter([rep], telemetry=rep.telemetry)
+        with router:
+            client = LoopbackClient(router, telemetry=telemetry_client)
+            # Warm every bucket outside the measured window.
+            import numpy as _np
+            for b in buckets:
+                LoopbackClient(router).submit(
+                    _np.zeros((b, 32, 32, 3), _np.uint8), tier=0,
+                    slo_ms=60_000.0).result(timeout=120)
+            stats = demo.replay_load(client, trace, pool=pool, seed=seed,
+                                     drain_timeout_s=60.0)
+        return stats
+
+    out = {"backend": jax.default_backend(), "model": model,
+           "buckets": list(buckets)}
+
+    # -- capacity: tracing off vs on -------------------------------------
+    log(f"[bench] tracing: capacity {capacity_requests} reqs @ "
+        f"{capacity_rps:g} rps, {capacity_repeats}x off vs on")
+    rep_off = _build(telemetry=None)
+    off_runs = [_goodput(rep_off) for _ in range(capacity_repeats)]
+    off = sorted(off_runs, key=lambda s: s["goodput_rps"])[len(off_runs) // 2]
+    on_runs = []
+    with tempfile.TemporaryDirectory() as td:
+        for i in range(capacity_repeats):
+            stel = Telemetry(os.path.join(td, f"srv{i}"))
+            ctel = Telemetry(os.path.join(td, f"cli{i}"))
+            rep_on = _build(telemetry=stel)
+            on_runs.append(_goodput(rep_on, telemetry_client=ctel))
+            stel.finalize()
+            ctel.finalize()
+    on = sorted(on_runs, key=lambda s: s["goodput_rps"])[len(on_runs) // 2]
+    overhead = 1.0 - on["goodput_rps"] / max(off["goodput_rps"], 1e-9)
+    out["capacity"] = {
+        "offered_rps": off["offered_rps"],
+        "slo_ms": capacity_slo_ms,
+        "tracing_off": {"goodput_rps": off["goodput_rps"],
+                        "attainment": off["attainment"],
+                        "runs": [s["goodput_rps"] for s in off_runs]},
+        "tracing_on": {"goodput_rps": on["goodput_rps"],
+                       "attainment": on["attainment"],
+                       "runs": [s["goodput_rps"] for s in on_runs]},
+        "overhead_frac": round(overhead, 4),
+        "overhead_budget": 0.05,
+        "within_budget": overhead <= 0.05,
+    }
+    log(f"[bench] tracing: goodput off {off['goodput_rps']} vs on "
+        f"{on['goodput_rps']} rps -> overhead {overhead:.1%}")
+    if overhead > 0.05:
+        log(f"[bench] tracing: WARNING overhead {overhead:.1%} exceeds "
+            "the 5% budget")
+
+    # -- two OS processes -> one skew-corrected waterfall ----------------
+    log(f"[bench] tracing: two-process run, serve_load.py subprocess "
+        f"{subprocess_requests} reqs @ {subprocess_rps:g} rps")
+    with tempfile.TemporaryDirectory() as td:
+        srv_dir = os.path.join(td, "server")
+        cli_dir = os.path.join(td, "client")
+        stel = Telemetry(srv_dir)
+        rep = _build(telemetry=stel)
+        prior_flops = cost_model_weights(rep.engine, precision)
+        router = ReplicaRouter([rep], telemetry=stel)
+        replay = None
+        with router:
+            with ServingFrontend(router, telemetry=stel) as fe:
+                import numpy as _np
+                with FrontendClient(fe.address) as warm:
+                    for b in buckets:
+                        warm.submit(_np.zeros((b, 32, 32, 3), _np.uint8),
+                                    tier=0, slo_ms=60_000.0).result(120)
+                proc = subprocess.run(
+                    [sys.executable,
+                     os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "tools", "serve_load.py"),
+                     "replay", "--port", str(fe.address[1]),
+                     "--rps", f"{subprocess_rps:g}",
+                     "--requests", str(subprocess_requests),
+                     "--max-size", str(buckets[-1]),
+                     "--seed", str(seed + 7),
+                     "--telemetry-out", cli_dir, "--timeout", "120"],
+                    capture_output=True, text=True, timeout=300)
+                if proc.returncode == 0:
+                    replay = _json.loads(proc.stdout.strip().splitlines()[-1])
+                else:
+                    log("[bench] tracing: WARNING replay subprocess failed: "
+                        + proc.stderr[-500:])
+        stel.finalize()
+        t0 = _time.time()
+        report = _agg.aggregate_run_dirs([srv_dir, cli_dir],
+                                         prior_flops=prior_flops,
+                                         max_waterfalls=2)
+        agg_wall_s = _time.time() - t0
+    two = {
+        "replay": ({k: replay[k] for k in ("n_requests", "goodput_rps",
+                                           "attainment")}
+                   if replay else None),
+        "aggregate_wall_s": round(agg_wall_s, 4),
+        "traces": report["traces"],
+        "complete": report["complete"],
+        "orphaned": report["orphaned"],
+        "skew": {n: p for n, p in report["processes"].items()
+                 if p["skew_estimated"] and p["skew_pairs"]},
+        "stage_ms": report["stage_ms"],
+        "residual_ms": report.get("client_minus_stages_ms"),
+        "cost_prior": report.get("cost_prior"),
+        "waterfall_example": (report["waterfalls"][0]
+                              if report["waterfalls"] else None),
+    }
+    out["two_process"] = two
+    if two["residual_ms"]:
+        log(f"[bench] tracing: {two['complete']} complete waterfalls, "
+            f"client-minus-stages residual p50 "
+            f"{two['residual_ms']['p50']} ms, aggregation "
+            f"{agg_wall_s * 1e3:.0f} ms")
+    return out
+
+
 def run_hotswap(log, *, model: str = "servenet", buckets=None,
                 n_replicas: int = 2, n_requests: int = 400,
                 offered_rps: float = 600.0, slo_ms: float = 2000.0,
@@ -1525,6 +1698,7 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
               robustness: bool = True, serving: bool = True,
               serving_load: bool = True,
               hotswap: bool = True,
+              tracing: bool = True,
               elastic: bool = True,
               audit: bool = True,
               attribution: bool = True,
@@ -1862,6 +2036,12 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
     if hotswap:
         result["hotswap"] = run_hotswap(log)
 
+    # Distributed tracing (round 12): capacity with tracing off vs on
+    # (<= 5% overhead pin), and a real two-OS-process run reconstructed
+    # into skew-corrected waterfalls by obs/aggregate.py.
+    if tracing:
+        result["tracing"] = run_tracing(log)
+
     # Elastic layer: shrink/grow resume latency, steps lost, and
     # degraded single-rank throughput (cs744_ddp_tpu/elastic/).
     if elastic:
@@ -2059,6 +2239,11 @@ def main(argv=None) -> None:
                         "p50/p99, in-flight work at publish, goodput dip "
                         "vs steady, rolling vs all-at-once, zero-recompile "
                         "pin)")
+    p.add_argument("--no-tracing", action="store_true",
+                   help="skip the distributed-tracing section (capacity "
+                        "tracing off vs on with the 5% overhead pin, "
+                        "two-OS-process waterfall reconstruction, "
+                        "aggregation wall clock)")
     p.add_argument("--no-elastic", action="store_true",
                    help="skip the elastic section (shrink/grow resume "
                         "latency, steps lost, degraded single-rank "
@@ -2113,6 +2298,7 @@ def main(argv=None) -> None:
                        serving_load=not (args.no_serving_load
                                          or args.no_matrix),
                        hotswap=not (args.no_hotswap or args.no_matrix),
+                       tracing=not (args.no_tracing or args.no_matrix),
                        elastic=not (args.no_elastic or args.no_matrix),
                        audit=not (args.no_audit or args.no_matrix),
                        attribution=not (args.no_attribution

@@ -1,6 +1,6 @@
 """AST lint for repo invariants the runtime can't see.
 
-Three rules, each encoding a concurrency/measurement discipline this
+Four rules, each encoding a concurrency/measurement discipline this
 codebase depends on but no test can reliably catch (the failure is a
 silent mis-measurement or a rare race, not an exception):
 
@@ -29,6 +29,16 @@ silent mis-measurement or a rare race, not an exception):
   coordinator declares its membership state this way, so a new method
   that mutates membership unlocked fails the lint even before any locked
   counterpart exists).
+
+- ``span-hygiene`` — a span emitted under one of the distributed-trace
+  names (``trace_client``/``frontend_request``/``wire_decode``/
+  ``sched_queue``/``sched_defer``/``reply_encode``) must carry the
+  trace-context join keys (``**ctx.attrs()`` or an explicit
+  ``trace_id=``); batch-level engine spans (``serve_stage``/
+  ``serve_dispatch``/``serve_fetch``) must carry their member batcher
+  trace ids (``traces=``).  A span missing its keys still renders in
+  single-process reports, but the cross-process waterfall silently
+  loses that stage — exactly the failure no test sees.
 
 Waiver: append ``# lint: ok`` to the offending line to waive every rule,
 or ``# lint: ok(rule-name[, rule-name])`` to waive specific rules.  Run
@@ -358,10 +368,87 @@ def _check_lock_ownership(tree: ast.AST, path: str) -> List[LintFinding]:
 
 
 # ---------------------------------------------------------------------------
+# span-hygiene
+# ---------------------------------------------------------------------------
+
+# The distributed-trace span vocabulary (obs/aggregate.py's contract).
+# Per-request spans must carry the TraceContext join keys
+# (trace_id/span_id/parent_span_id via ``**ctx.attrs()``); batch-level
+# engine spans must carry the member batcher trace ids (``traces=``).
+TRACED_SPAN_NAMES = frozenset({
+    "trace_client", "frontend_request", "wire_decode", "sched_queue",
+    "sched_defer", "reply_encode"})
+BATCH_SPAN_NAMES = frozenset({"serve_stage", "serve_dispatch",
+                              "serve_fetch"})
+
+
+def _attrs_splat_names(fn: ast.AST) -> Set[str]:
+    """Local names assigned from an ``<expr>.attrs()`` call inside this
+    function — splatting one of these carries the trace context too."""
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "attrs"):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _check_span_hygiene(tree: ast.AST, path: str) -> List[LintFinding]:
+    """A span emitted under one of the distributed-trace names without
+    its join keys is invisible to the cross-process aggregation — the
+    waterfall silently loses that stage.  No test catches it (the span
+    still renders in single-process reports), hence the lint."""
+    findings: List[LintFinding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        attrs_vars = _attrs_splat_names(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node) not in ("span", "span_event"):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            span = node.args[0].value
+            kwargs = {kw.arg for kw in node.keywords if kw.arg}
+            has_ctx_splat = any(
+                kw.arg is None
+                and ((isinstance(kw.value, ast.Call)
+                      and isinstance(kw.value.func, ast.Attribute)
+                      and kw.value.func.attr == "attrs")
+                     or (isinstance(kw.value, ast.Name)
+                         and kw.value.id in attrs_vars))
+                for kw in node.keywords)
+            if span in TRACED_SPAN_NAMES \
+                    and not (has_ctx_splat or "trace_id" in kwargs):
+                findings.append(LintFinding(
+                    "span-hygiene", path, node.lineno,
+                    f"span {span!r} emitted without trace-context attrs "
+                    f"(**ctx.attrs() or trace_id=...) — the cross-process "
+                    f"waterfall cannot join it"))
+            elif span in BATCH_SPAN_NAMES \
+                    and not (has_ctx_splat or "traces" in kwargs
+                             or "trace_id" in kwargs):
+                findings.append(LintFinding(
+                    "span-hygiene", path, node.lineno,
+                    f"batch span {span!r} emitted without traces= (member "
+                    f"batcher trace ids) — requests cannot be joined to "
+                    f"this dispatch"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------
 
-RULES = (_check_unfenced_timing, _check_thread_jnp, _check_lock_ownership)
+RULES = (_check_unfenced_timing, _check_thread_jnp, _check_lock_ownership,
+         _check_span_hygiene)
 
 
 def lint_source(source: str, path: str = "<source>") -> List[LintFinding]:

@@ -263,6 +263,18 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="MS",
                     help="publish-directory poll interval for "
                          "--serve-publish-dir (default 50 ms)")
+    sv.add_argument("--serve-trace-client", default=None, metavar="DIR",
+                    help="write the in-process load client's distributed-"
+                         "trace spans (events.jsonl) to DIR — a second "
+                         "stream for tools/trace_waterfall.py; server "
+                         "spans ride --telemetry-out (only with "
+                         "--serve-frontend)")
+    sv.add_argument("--serve-alerts", default="on", choices=["on", "off"],
+                    help="attach the streaming SLO alert engine "
+                         "(obs/alerts.py) to the server telemetry; the "
+                         "fired-rule summary lands in the manifest and "
+                         "the output JSON (default on; needs "
+                         "--telemetry-out)")
     au = p.add_argument_group(
         "static analysis (analysis/)",
         "HLO/jaxpr program audit: certify each compiled program's cost "
@@ -409,6 +421,15 @@ def serve_frontend_main(args, telemetry) -> None:
     chaos = ft.chaos if ft is not None else NULL_CHAOS
     buckets = demo.parse_buckets(args.serve_buckets)
     shed = args.serve_shed == "on"
+    alerts = None
+    if telemetry.enabled and args.serve_alerts == "on":
+        from .obs import AlertEngine
+        alerts = AlertEngine(telemetry)
+        telemetry.add_tap(alerts.observe)
+    client_tel = None
+    if args.serve_trace_client is not None:
+        client_tel = Telemetry(args.serve_trace_client)
+        client_tel.write_manifest({"mode": "serve-frontend-client"})
     devices = jax.devices()
     replicas = [
         EngineReplica(i, args.model, device=devices[i % len(devices)],
@@ -452,20 +473,27 @@ def serve_frontend_main(args, telemetry) -> None:
                     trace = demo.synthetic_load_trace(
                         args.serve_requests, offered_rps=rps,
                         seed=args.serve_seed, size_choices=sizes, tiers=tiers)
-                    with FrontendClient(frontend.address) as client:
+                    with FrontendClient(frontend.address,
+                                        telemetry=client_tel) as client:
                         stats[f"{rps:g}rps"] = demo.replay_load(
                             client, trace, pool=pool, seed=args.serve_seed)
         finally:
             if watcher is not None:
                 watcher.stop()
+            if client_tel is not None:
+                client_tel.finalize()
     out = {"address": list(address), "startup": startup,
            "router": router.stats(), "load": stats}
     if watcher is not None:
         out["publish"] = watcher.report()
+    if alerts is not None:
+        out["alerts"] = alerts.summary()
     if telemetry.enabled:
         telemetry.update_manifest({"router": router.stats()})
         if watcher is not None:
             telemetry.update_manifest({"publish": watcher.report()})
+        if alerts is not None:
+            telemetry.update_manifest({"alerts": alerts.summary()})
     print(json.dumps(out))
 
 

@@ -9,8 +9,12 @@ costs nothing: ``NULL`` is a stateless no-op recorder and every hot call
 site guards on ``telemetry.enabled``.
 """
 
+from .alerts import RULES as ALERT_RULES
+from .alerts import Alert, AlertEngine
 from .telemetry import (NULL, NullTelemetry, Telemetry, git_sha, percentile,
                         read_run, summarize_events)
+from .tracing import TraceContext
 
-__all__ = ["NULL", "NullTelemetry", "Telemetry", "git_sha", "percentile",
-           "read_run", "summarize_events"]
+__all__ = ["ALERT_RULES", "Alert", "AlertEngine", "NULL", "NullTelemetry",
+           "Telemetry", "TraceContext", "git_sha", "percentile", "read_run",
+           "summarize_events"]

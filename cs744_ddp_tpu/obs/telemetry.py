@@ -172,6 +172,16 @@ class NullTelemetry:
     def span(self, name: str, **attrs):
         return _NULL_SPAN
 
+    def span_event(self, name: str, t0: float, dur_s: float,
+                   **attrs) -> None:
+        pass
+
+    def alert(self, rule: str, severity: str, **attrs) -> None:
+        pass
+
+    def add_tap(self, fn) -> None:
+        pass
+
     def counter_totals(self) -> Dict[str, float]:
         return {}
 
@@ -238,6 +248,7 @@ class Telemetry:
         self._lock = threading.Lock()  # producer thread emits spans too
         self._tls = threading.local()
         self._counters: Dict[str, float] = {}
+        self._taps: List = []   # live record observers (alert engine)
         if rotate_keep < 1:
             raise ValueError(f"rotate_keep must be >= 1, got {rotate_keep}")
         self._rotate_bytes = int(rotate_bytes)
@@ -280,6 +291,17 @@ class Telemetry:
                     self._rotate_locked()
             else:
                 self.records.append(rec)
+        # Taps run OUTSIDE the writer lock: a tap that emits (the alert
+        # engine firing through ``alert()``) re-enters ``_emit`` on the
+        # same thread, which would deadlock under the held lock.
+        for tap in self._taps:
+            tap(rec)
+
+    def add_tap(self, fn) -> None:
+        """Register a live record observer, called once per emitted
+        record (after it is written).  Taps must be fast and must not
+        raise — the serve path runs through them."""
+        self._taps.append(fn)
 
     def _rotate_locked(self) -> None:
         """Shift the rotated generations up one slot (dropping the one
@@ -332,6 +354,30 @@ class Telemetry:
 
     def span(self, name: str, **attrs) -> _Span:
         return _Span(self, name, attrs)
+
+    def span_event(self, name: str, t0: float, dur_s: float,
+                   **attrs) -> None:
+        """Record an ALREADY-MEASURED interval as a span event.  Unlike
+        ``span()`` (a context manager bound to one thread's span stack)
+        this suits asynchronous intervals whose endpoints live on
+        different threads or came off the wire — a client round-trip, a
+        queue wait — so depth is 0 and parenting comes from the caller's
+        trace attrs, not the thread-local stack."""
+        rec = {"kind": "span", "name": name, "t": float(t0),
+               "dur_s": float(dur_s), "depth": 0}
+        if attrs:
+            rec.update(attrs)
+        self._emit(rec)
+
+    def alert(self, rule: str, severity: str, **attrs) -> None:
+        """Record a structured alert event (``kind: "alert"``) — the
+        ``obs/alerts.py`` rules engine emits these; ``summarize_events``
+        rolls them up under ``summary["alerts"]``."""
+        rec = {"kind": "alert", "rule": rule, "severity": severity,
+               "t": time.time()}
+        if attrs:
+            rec.update(attrs)
+        self._emit(rec)
 
     def counter_totals(self) -> Dict[str, float]:
         """Current counter totals (a copy) without draining the event log
@@ -490,6 +536,17 @@ def summarize_events(events: List[Dict[str, Any]],
                           "shed_by_reason": shed_reasons}
         if replica_util:
             summary["slo"]["replica_util"] = replica_util
+    # Alert roll-up (round 12): structured ``kind: "alert"`` events from
+    # the obs/alerts.py rules engine, grouped by deterministic rule id so
+    # chaos drills can pin exactly which rules fired from the summary.
+    alerts: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("kind") == "alert":
+            agg = alerts.setdefault(str(e.get("rule", "unknown")), {
+                "count": 0, "severity": str(e.get("severity", "warn"))})
+            agg["count"] += 1
+    if alerts:
+        summary["alerts"] = alerts
     if steps:
         summary["final_loss"] = steps[-1]["loss"]
         summary["mean_loss"] = sum(s["loss"] for s in steps) / len(steps)

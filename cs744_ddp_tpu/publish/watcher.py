@@ -118,6 +118,12 @@ class WeightWatcher:
             return "none"
         self._pointer = dict(latest)        # lint: ok(lock-ownership)
         version = int(latest["version"])
+        if tel.enabled:
+            # The watcher-side freshness signal the PUBLISH_LAG alert
+            # rule (obs/alerts.py) tracks: newest LATEST version seen
+            # vs what this watcher has installed.
+            tel.gauge("publish_latest_seen", version,
+                      installed=self._installed_version)
         if version <= self._installed_version:
             self._counts["stale"] += 1      # lint: ok(lock-ownership)
             if tel.enabled:

@@ -150,15 +150,17 @@ def next_trace_id() -> int:
 
 
 class _Request:
-    __slots__ = ("images", "labels", "future", "t_enqueue", "n", "trace")
+    __slots__ = ("images", "labels", "future", "t_enqueue", "n", "trace",
+                 "ctx")
 
-    def __init__(self, images, labels, trace: int):
+    def __init__(self, images, labels, trace: int, ctx=None):
         self.images = images
         self.labels = labels
         self.n = len(images)
         self.future: Future = Future()
         self.t_enqueue = time.time()
         self.trace = trace
+        self.ctx = ctx               # upstream TraceContext, or None
 
 
 class MicroBatcher:
@@ -231,10 +233,13 @@ class MicroBatcher:
 
     # -- client side --------------------------------------------------------
 
-    def submit(self, images: np.ndarray, labels=None) -> Future:
+    def submit(self, images: np.ndarray, labels=None, *,
+               ctx=None) -> Future:
         """Enqueue one request (n <= largest bucket images); the Future
         resolves to this request's logits [n, 10].  Raises ``QueueFull``
-        when accepting it would exceed the image bound."""
+        when accepting it would exceed the image bound.  ``ctx``
+        (upstream ``TraceContext``) parents this request's queue span
+        into the caller's distributed trace."""
         images = np.ascontiguousarray(images, np.uint8)
         n = len(images)
         if n > self.engine.max_batch:
@@ -244,14 +249,15 @@ class MicroBatcher:
         trace = next_trace_id()
         if tel.enabled:
             with tel.span("serve_enqueue", n=n, trace=trace):
-                fut = self._enqueue(images, labels, n, trace)
+                fut = self._enqueue(images, labels, n, trace, ctx)
             with self._cond:
                 tel.gauge("queue_depth", self._pending_images)
             return fut
-        return self._enqueue(images, labels, n, trace)
+        return self._enqueue(images, labels, n, trace, ctx)
 
-    def _enqueue(self, images, labels, n: int, trace: int) -> Future:
-        req = _Request(images, labels, trace)
+    def _enqueue(self, images, labels, n: int, trace: int,
+                 ctx=None) -> Future:
+        req = _Request(images, labels, trace, ctx)
         with self._cond:
             if self._worker is None or self._stop:
                 raise RuntimeError("micro-batcher is not running")
@@ -348,6 +354,12 @@ class MicroBatcher:
                         tel.gauge("serve_service_ms",
                                   round((t_done - t_svc0) * 1e3, 3),
                                   bucket=bucket, n=r.n, trace=r.trace)
+                        if r.ctx is not None:
+                            tel.span_event(
+                                "sched_queue", r.t_enqueue,
+                                t_svc0 - r.t_enqueue, trace=r.trace,
+                                bucket=bucket,
+                                **r.ctx.child("batcher").attrs())
                 if tel.enabled:
                     with self._cond:
                         tel.gauge("queue_depth", self._pending_images)

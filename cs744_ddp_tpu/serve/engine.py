@@ -309,11 +309,13 @@ class InferenceEngine:
         padded_labels = np.full((bucket,), -1, np.int32)
         if labels is not None:
             padded_labels[:n] = np.asarray(labels, np.int32)
-        staged = self._pad_stage(images, bucket)
         tel = self.telemetry
         if tel.enabled:
             tel.counter(f"serve_bucket_{bucket}")
             traces = list(trace_ids)
+            with tel.span("serve_stage", bucket=bucket, n=n,
+                          traces=traces):
+                staged = self._pad_stage(images, bucket)
             with tel.span("serve_dispatch", bucket=bucket, n=n,
                           traces=traces):
                 logits, loss_sum, correct = ex(self.params, self.bn_state,
@@ -322,6 +324,7 @@ class InferenceEngine:
                 out = np.asarray(logits)[:n]
                 counts = (float(loss_sum), int(correct))
         else:
+            staged = self._pad_stage(images, bucket)
             logits, loss_sum, correct = ex(self.params, self.bn_state,
                                            staged, padded_labels)
             out = np.asarray(logits)[:n]

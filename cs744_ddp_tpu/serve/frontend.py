@@ -11,6 +11,16 @@ Wire format (all little-endian, u32 frame-length prefix per message):
   request (publish/ hot-swap A/B pin), -1 when it never reached a
   dispatch, n u16) + n x 10 f32 logits when status is ok/late.
 
+Both frames may carry an OPTIONAL TRAILING EXTENSION BLOCK (round 12,
+``obs/tracing.py``: magic+version byte then TLV fields, unknown tags
+skipped by length).  Requests use it for the distributed
+``TraceContext``; replies for the server's recv/send timestamps (the
+client side of clock-skew estimation).  Encoding without a context is
+byte-identical to the pre-round-12 format, and the decoders accept
+extension-free frames — old and new peers mix freely in either
+direction; trailing bytes that are NOT a versioned extension block
+still fail decode (torn frames must not pass silently).
+
 Statuses: 0 ok, 1 late (served past deadline), 2 shed, 3 overload
 (rejected at admission — ``retry_after_ms`` carries the micro-batcher's
 backpressure hint, the satellite fix), 4 error.  Every request gets
@@ -29,12 +39,16 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..obs import NULL
+from ..obs.tracing import (TAG_SERVER_TIMES, TAG_TRACE, TraceContext,
+                           pack_ext, pack_server_times, pack_trace,
+                           unpack_ext, unpack_server_times, unpack_trace)
 from .batcher import QueueFull
 
 IMAGE_BYTES = 32 * 32 * 3
@@ -50,38 +64,70 @@ REASON_CODES = {"": 0, "deadline": 1, "predicted_miss": 2, "queue_full": 3,
                 "internal": 4}
 REASON_NAMES = {v: k for k, v in REASON_CODES.items()}
 
-MAX_FRAME = _REQ.size + 65535 * IMAGE_BYTES
+# 4 KiB of slack past the fixed layout for trailing extension blocks.
+MAX_FRAME = _REQ.size + 65535 * IMAGE_BYTES + 4096
 
 
 # -- codec ------------------------------------------------------------------
 
 
+def _split_ext(body: bytes, fixed: int, what: str) -> Tuple[bytes, dict]:
+    """Split a frame body into (fixed-layout bytes, decoded extension
+    fields).  Trailing bytes must be a versioned extension block
+    (``unpack_ext`` magic-gates them) — anything else is a torn frame
+    and still fails decode, exactly as the pre-extension codec did."""
+    if len(body) < fixed:
+        raise ValueError(f"{what} body {len(body)} B < {fixed} B")
+    tail = body[fixed:]
+    if not tail:
+        return body, {}
+    fields = unpack_ext(tail)
+    if not fields:
+        raise ValueError(f"{what} body {len(body)} B != {fixed} B "
+                         "(trailing bytes are not an extension block)")
+    return body[:fixed], fields
+
+
 def encode_request(req_id: int, images: np.ndarray, *, tier: int = 0,
-                   slo_ms: Optional[float] = None) -> bytes:
+                   slo_ms: Optional[float] = None,
+                   ctx: Optional[TraceContext] = None) -> bytes:
     images = np.ascontiguousarray(images, np.uint8)
     n = int(images.shape[0])
     if not 0 < n <= 65535:
         raise ValueError(f"bad request size {n}")
     slo = -1.0 if slo_ms is None else float(slo_ms)
+    ext = b"" if ctx is None else pack_ext({TAG_TRACE: pack_trace(ctx)})
     return _REQ.pack(req_id & 0xFFFFFFFF, MSG_INFER, int(tier) & 0xFF,
-                     slo, n) + images.tobytes()
+                     slo, n) + images.tobytes() + ext
 
 
-def decode_request(payload: bytes
-                   ) -> Tuple[int, np.ndarray, int, Optional[float]]:
+def decode_request_ex(payload: bytes
+                      ) -> Tuple[int, np.ndarray, int, Optional[float],
+                                 Optional[TraceContext]]:
+    """Decode a request frame -> (req_id, images, tier, slo_ms, ctx).
+    ``ctx`` is None for extension-free (old-client) frames."""
     if len(payload) < _REQ.size:
         raise ValueError(f"short request frame ({len(payload)} B)")
     req_id, msg, tier, slo, n = _REQ.unpack_from(payload)
     if msg != MSG_INFER:
         raise ValueError(f"unknown message type {msg}")
-    body = payload[_REQ.size:]
-    if len(body) != n * IMAGE_BYTES:
-        raise ValueError(f"request body {len(body)} B != {n} images")
+    body, fields = _split_ext(payload[_REQ.size:], n * IMAGE_BYTES,
+                              "request")
     images = np.frombuffer(body, np.uint8).reshape(n, 32, 32, 3)
-    return req_id, images, tier, (None if slo <= 0 else slo)
+    ctx = unpack_trace(fields[TAG_TRACE]) if TAG_TRACE in fields else None
+    return req_id, images, tier, (None if slo <= 0 else slo), ctx
 
 
-def encode_reply(req_id: int, reply) -> bytes:
+def decode_request(payload: bytes
+                   ) -> Tuple[int, np.ndarray, int, Optional[float]]:
+    """The pre-round-12 4-tuple surface (extension fields tolerated and
+    dropped) — existing callers keep working unchanged."""
+    req_id, images, tier, slo_ms, _ctx = decode_request_ex(payload)
+    return req_id, images, tier, slo_ms
+
+
+def encode_reply(req_id: int, reply, *, t_recv: Optional[float] = None,
+                 t_send: Optional[float] = None) -> bytes:
     """``reply`` is a ``scheduler.Reply`` or an equivalent dict."""
     get = reply.get if isinstance(reply, dict) else \
         lambda k, d=None: getattr(reply, k, d)
@@ -97,11 +143,13 @@ def encode_reply(req_id: int, reply) -> bytes:
     rcode = REASON_CODES.get(reason.split(":")[0],
                              REASON_CODES["internal"] if reason else 0)
     mv = get("model_version")
+    ext = b"" if t_recv is None or t_send is None else \
+        pack_ext({TAG_SERVER_TIMES: pack_server_times(t_recv, t_send)})
     return _REP.pack(req_id & 0xFFFFFFFF, status, rcode,
                      int(get("trace") or 0), float(get("retry_after_ms") or 0.0),
                      float(get("queue_wait_ms") or 0.0),
                      float(get("service_ms") or 0.0),
-                     -1 if mv is None else int(mv), n) + blob
+                     -1 if mv is None else int(mv), n) + blob + ext
 
 
 def decode_reply(payload: bytes) -> dict:
@@ -109,16 +157,19 @@ def decode_reply(payload: bytes) -> dict:
         raise ValueError(f"short reply frame ({len(payload)} B)")
     req_id, status, rcode, trace, retry, qw, svc, mv, n = \
         _REP.unpack_from(payload)
-    body = payload[_REP.size:]
+    body, fields = _split_ext(payload[_REP.size:], n * 40, "reply")
     logits = None
     if n:
-        if len(body) != n * 40:
-            raise ValueError(f"reply body {len(body)} B != {n} rows")
         logits = np.frombuffer(body, np.float32).reshape(n, 10).copy()
-    return {"req_id": req_id, "status": STATUS_NAMES.get(status, "error"),
-            "reason": REASON_NAMES.get(rcode, "internal"), "trace": trace,
-            "retry_after_ms": retry, "queue_wait_ms": qw, "service_ms": svc,
-            "model_version": mv, "logits": logits}
+    rep = {"req_id": req_id, "status": STATUS_NAMES.get(status, "error"),
+           "reason": REASON_NAMES.get(rcode, "internal"), "trace": trace,
+           "retry_after_ms": retry, "queue_wait_ms": qw, "service_ms": svc,
+           "model_version": mv, "logits": logits}
+    if TAG_SERVER_TIMES in fields:
+        times = unpack_server_times(fields[TAG_SERVER_TIMES])
+        if times is not None:
+            rep["t_recv"], rep["t_send"] = times
+    return rep
 
 
 def reply_to_dict(reply) -> dict:
@@ -267,44 +318,88 @@ class ServingFrontend:
                     return
                 if payload is None:
                     return
+                t_recv = time.time()
                 try:
-                    req_id, images, tier, slo_ms = decode_request(payload)
+                    req_id, images, tier, slo_ms, ctx = \
+                        decode_request_ex(payload)
                 except ValueError:
                     return       # malformed frame: drop the connection
+                # The frontend hop's own context: child of the client's
+                # when the request carried one, else a fresh root (old
+                # clients stay traceable server-side).  NULL recorder ->
+                # no context, no allocations.
+                sctx = None
+                if tel.enabled:
+                    sctx = ctx.child("frontend") if ctx is not None \
+                        else TraceContext.new_root("frontend")
+                    tel.span_event("wire_decode", t_recv,
+                                   time.time() - t_recv,
+                                   **sctx.child("frontend").attrs())
                 try:
-                    fut = self.backend.submit(images, tier=tier,
-                                              slo_ms=slo_ms)
+                    if sctx is not None:
+                        fut = self.backend.submit(images, tier=tier,
+                                                  slo_ms=slo_ms, ctx=sctx)
+                    else:
+                        fut = self.backend.submit(images, tier=tier,
+                                                  slo_ms=slo_ms)
                 except QueueFull as e:
                     if tel.enabled:
                         tel.counter("frontend_overload", tier=tier)
-                    self._send(conn, send_lock, encode_reply(req_id, {
+                    self._reply_now(conn, send_lock, req_id, {
                         "status": "overload", "reason": "queue_full",
                         "retry_after_ms": getattr(e, "retry_after_ms", 0.0),
-                    }))
+                    }, t_recv=t_recv, ctx=sctx)
                     continue
                 except (RuntimeError, ValueError) as e:
-                    self._send(conn, send_lock, encode_reply(req_id, {
+                    self._reply_now(conn, send_lock, req_id, {
                         "status": "error", "reason": "internal",
-                    }))
+                    }, t_recv=t_recv, ctx=sctx)
                     del e
                     continue
                 if tel.enabled:
                     tel.counter("frontend_accepted", tier=tier)
                 fut.add_done_callback(
-                    lambda f, rid=req_id, lk=send_lock, c=conn:
-                    self._on_reply(c, lk, rid, f))
+                    lambda f, rid=req_id, lk=send_lock, c=conn, tr=t_recv,
+                    sc=sctx: self._on_reply(c, lk, rid, f, t_recv=tr,
+                                            ctx=sc))
         finally:
             try:
                 conn.close()
             except OSError:
                 pass
 
-    def _on_reply(self, conn, send_lock, req_id: int, fut) -> None:
+    def _on_reply(self, conn, send_lock, req_id: int, fut, *,
+                  t_recv: Optional[float] = None, ctx=None) -> None:
         try:
             reply = fut.result()
         except Exception:
             reply = {"status": "error", "reason": "internal"}
-        self._send(conn, send_lock, encode_reply(req_id, reply))
+        self._reply_now(conn, send_lock, req_id, reply,
+                        t_recv=t_recv, ctx=ctx)
+
+    def _reply_now(self, conn, send_lock, req_id: int, reply, *,
+                   t_recv: Optional[float] = None, ctx=None) -> None:
+        """Encode + send one reply; when traced, stamp the server's
+        recv/send window into the wire extension AND emit the
+        ``frontend_request`` span the skew estimator matches against
+        the client's ``trace_client`` span."""
+        tel = self.telemetry
+        if ctx is None or not tel.enabled:
+            self._send(conn, send_lock, encode_reply(req_id, reply))
+            return
+        t0 = time.time()
+        payload = encode_reply(req_id, reply, t_recv=t_recv, t_send=t0)
+        tel.span_event("reply_encode", t0, time.time() - t0,
+                       **ctx.child("frontend").attrs())
+        self._send(conn, send_lock, payload)
+        get = reply.get if isinstance(reply, dict) else \
+            lambda k, d=None: getattr(reply, k, d)
+        attrs = ctx.attrs()
+        if get("trace"):
+            attrs["trace"] = get("trace")
+        attrs["status"] = get("status")
+        tel.span_event("frontend_request", t_recv,
+                       time.time() - t_recv, **attrs)
 
     @staticmethod
     def _send(conn, send_lock, payload: bytes) -> None:
@@ -318,14 +413,36 @@ class ServingFrontend:
 # -- clients ----------------------------------------------------------------
 
 
+def _trace_client_reply(tel, ctx: TraceContext, t1: float, fut) -> None:
+    """Future done-callback: emit the client round-trip span (t1..t4 on
+    the CLIENT clock) carrying the trace context plus whatever join keys
+    the reply brought back (batcher trace id, server recv/send times)."""
+    try:
+        rep = fut.result()
+    except Exception:
+        rep = None
+    t4 = time.time()
+    attrs = ctx.attrs()
+    if isinstance(rep, dict):
+        if rep.get("trace"):
+            attrs["trace"] = rep["trace"]
+        if "t_recv" in rep:
+            attrs["server_t_recv"] = rep["t_recv"]
+            attrs["server_t_send"] = rep["t_send"]
+        attrs["status"] = rep.get("status")
+    tel.span_event("trace_client", t1, t4 - t1, **attrs)
+
+
 class FrontendClient:
     """Socket client: pipelined submits, replies matched by ``req_id``
     from a reader thread; each submit returns a Future of a reply dict."""
 
     _lock_owned = ("_futs", "_next_id")
 
-    def __init__(self, address: Tuple[str, int], *, timeout: float = 60.0):
+    def __init__(self, address: Tuple[str, int], *, timeout: float = 60.0,
+                 telemetry=None):
         self.timeout = timeout
+        self.telemetry = telemetry if telemetry is not None else NULL
         self._sock = socket.create_connection(address, timeout=timeout)
         self._lock = threading.Lock()
         self._futs: Dict[int, Future] = {}
@@ -337,17 +454,28 @@ class FrontendClient:
     def submit(self, images, *, tier: int = 0,
                slo_ms: Optional[float] = None) -> Future:
         fut = Future()
+        tel = self.telemetry
+        # A telemetry-carrying client is a TRACING client: it mints the
+        # root context every downstream hop parents under and records
+        # the t1..t4 round-trip the skew estimator pairs with the
+        # server's frontend_request window.
+        ctx = TraceContext.new_root("client") if tel.enabled else None
         with self._lock:
             req_id = self._next_id
             self._next_id += 1
             self._futs[req_id] = fut
+        t1 = time.time()
         try:
             write_frame(self._sock, encode_request(req_id, images,
-                                                   tier=tier, slo_ms=slo_ms))
+                                                   tier=tier, slo_ms=slo_ms,
+                                                   ctx=ctx))
         except OSError as e:
             with self._lock:
                 self._futs.pop(req_id, None)
             raise ConnectionError(f"frontend connection lost: {e}") from e
+        if ctx is not None:
+            fut.add_done_callback(
+                lambda f, c=ctx, t0=t1: _trace_client_reply(tel, c, t0, f))
         return fut
 
     def request(self, images, *, tier: int = 0,
@@ -406,13 +534,21 @@ class LoopbackClient:
     socket is wanted.  Overload is returned as a reply dict (like the
     wire does), not raised."""
 
-    def __init__(self, backend):
+    def __init__(self, backend, *, telemetry=None):
         self.backend = backend
+        self.telemetry = telemetry if telemetry is not None else NULL
 
     def submit(self, images, *, tier: int = 0,
                slo_ms: Optional[float] = None) -> Future:
+        tel = self.telemetry
+        ctx = TraceContext.new_root("client") if tel.enabled else None
+        t1 = time.time()
         try:
-            fut = self.backend.submit(images, tier=tier, slo_ms=slo_ms)
+            if ctx is not None:
+                fut = self.backend.submit(images, tier=tier, slo_ms=slo_ms,
+                                          ctx=ctx.child("frontend"))
+            else:
+                fut = self.backend.submit(images, tier=tier, slo_ms=slo_ms)
         except QueueFull as e:
             done = Future()
             done.set_result({"req_id": None, "status": "overload",
@@ -433,6 +569,9 @@ class LoopbackClient:
         out = Future()
         fut.add_done_callback(
             lambda f: out.set_result(reply_to_dict(f.result())))
+        if ctx is not None:
+            out.add_done_callback(
+                lambda f, c=ctx, t0=t1: _trace_client_reply(tel, c, t0, f))
         return out
 
     def request(self, images, *, tier: int = 0,

@@ -45,6 +45,7 @@ class EngineReplica:
                  use_staging: bool = True):
         tel = telemetry if telemetry is not None else NULL
         self.index = int(index)
+        self.telemetry = tel
         self.chaos = chaos
         self.slow_stall_s = float(slow_stall_s)
         # Non-blocking weight-watcher poll (publish.WeightWatcher attaches
@@ -72,18 +73,30 @@ class EngineReplica:
         if dispatch_no in ch.steps("slow_replica") \
                 and ch.seed_of("slow_replica", dispatch_no) == self.index \
                 and ch.fire("slow_replica", dispatch_no):
+            self._note_chaos("slow_replica", dispatch_no)
             time.sleep(self.slow_stall_s)
         if dispatch_no in ch.steps("swap_mid_batch") \
                 and ch.seed_of("swap_mid_batch", dispatch_no) == self.index \
                 and ch.fire("swap_mid_batch", dispatch_no) \
                 and self.swap_probe is not None:
+            self._note_chaos("swap_mid_batch", dispatch_no)
             self.swap_probe()
         if dispatch_no in ch.steps("replica_death") \
                 and ch.seed_of("replica_death", dispatch_no) == self.index \
                 and ch.fire("replica_death", dispatch_no):
+            self._note_chaos("replica_death", dispatch_no)
             raise ChaosError(
                 f"chaos: replica {self.index} died at dispatch "
                 f"{dispatch_no} (bucket {bucket})")
+
+    def _note_chaos(self, site: str, dispatch_no: int) -> None:
+        """Chaos firings are themselves telemetry: trace aggregation
+        attributes orphaned spans (a death's unfinished requests) and
+        straggler stalls to the injection that caused them, instead of
+        leaving them indistinguishable from real faults."""
+        if self.telemetry.enabled:
+            self.telemetry.counter("chaos_fired", site=site,
+                                   replica=self.index, dispatch=dispatch_no)
 
     # -- passthroughs ------------------------------------------------------
 

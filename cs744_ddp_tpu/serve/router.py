@@ -79,13 +79,15 @@ class ReplicaRouter:
         return sorted(live, key=lambda s: (s.outstanding_s(), s.replica))
 
     def submit(self, images, labels=None, *, tier: int = 0,
-               slo_ms: Optional[float] = None):
+               slo_ms: Optional[float] = None, ctx=None):
         """Admit one request onto the least-loaded replica; falls through
         to the next-loaded on ``QueueFull``.  Raises ``QueueFull`` with
         the smallest retry hint when every replica is saturated, or
-        ``RuntimeError`` when none is alive."""
+        ``RuntimeError`` when none is alive.  ``ctx`` (upstream
+        ``TraceContext``) rides the request into dispatch-time spans —
+        failover re-placement keeps it, like the trace id."""
         req = make_request(images, labels, tier=tier, slo_ms=slo_ms,
-                           max_batch=self.max_batch)
+                           max_batch=self.max_batch, ctx=ctx)
         return self._place(req)
 
     def _place(self, req: SchedRequest, exclude=None):

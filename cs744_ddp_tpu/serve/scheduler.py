@@ -51,10 +51,10 @@ class SchedRequest:
     """
 
     __slots__ = ("images", "labels", "n", "tier", "deadline", "t_arrival",
-                 "seq", "trace", "future")
+                 "seq", "trace", "future", "ctx", "t_defer")
 
     def __init__(self, images, labels, n, tier, deadline, t_arrival, seq,
-                 trace, future):
+                 trace, future, ctx=None):
         self.images = images
         self.labels = labels
         self.n = n
@@ -64,6 +64,8 @@ class SchedRequest:
         self.seq = seq
         self.trace = trace
         self.future = future
+        self.ctx = ctx            # upstream TraceContext (None = untraced)
+        self.t_defer = None       # first admit-deferral wall time
 
 
 class Reply(NamedTuple):
@@ -87,18 +89,22 @@ class Reply(NamedTuple):
 
 class Admission(NamedTuple):
     """One ``admit()`` decision: the batch to dispatch now, its bucket,
-    and the requests shed (with reasons)."""
+    the requests shed (with reasons), and the requests DEFERRED back to
+    the queue by miss repair (observable for trace attribution — they
+    stay pending, so deferral is otherwise invisible queue wait)."""
     batch: Tuple[SchedRequest, ...]
     bucket: Optional[int]
     shed: Tuple[Tuple[SchedRequest, str], ...]
     predicted_done: Optional[float]
+    deferred: Tuple[SchedRequest, ...] = ()
 
 
 def make_request(images, labels=None, *, tier: int = 0,
                  slo_ms: Optional[float] = None, now: Optional[float] = None,
                  seq: Optional[int] = None, trace: Optional[int] = None,
-                 max_batch: int = 256) -> SchedRequest:
-    """Build a live request (numpy-ified images, fresh Future/trace/seq)."""
+                 max_batch: int = 256, ctx=None) -> SchedRequest:
+    """Build a live request (numpy-ified images, fresh Future/trace/seq).
+    ``ctx`` is the upstream hop's ``TraceContext`` (or None)."""
     images = np.ascontiguousarray(images, np.uint8)
     n = int(images.shape[0])
     if n < 1:
@@ -115,7 +121,7 @@ def make_request(images, labels=None, *, tier: int = 0,
     return SchedRequest(images, labels, n, int(tier), deadline, t,
                         next(_seq_counter) if seq is None else int(seq),
                         next_trace_id() if trace is None else int(trace),
-                        Future())
+                        Future(), ctx)
 
 
 def virtual_requests(trace: Sequence[Tuple[float, int, int, float]]
@@ -175,6 +181,7 @@ def admit(pending: Sequence[SchedRequest], now: float, *,
             batch.append(r)
             total += r.n
     done = None
+    deferred: List[SchedRequest] = []
     while batch:
         done = now + predict_s(smallest_bucket(buckets, total))
         if not shed:
@@ -189,6 +196,7 @@ def admit(pending: Sequence[SchedRequest], now: float, *,
             victim = max(defer, key=lambda r: (r.tier, r.deadline, r.seq))
             batch.remove(victim)
             total -= victim.n
+            deferred.append(victim)
             done = None
             continue
         worst = max(r.tier for r in misses)
@@ -199,7 +207,8 @@ def admit(pending: Sequence[SchedRequest], now: float, *,
         shed_list.append((victim, "predicted_miss"))
         done = None
     bucket = smallest_bucket(buckets, total) if batch else None
-    return Admission(tuple(batch), bucket, tuple(shed_list), done)
+    return Admission(tuple(batch), bucket, tuple(shed_list), done,
+                     tuple(deferred))
 
 
 # -- virtual-time planners (deterministic replay over a trace) --------------
@@ -501,12 +510,13 @@ class SLOScheduler:
     # -- admission ---------------------------------------------------------
 
     def submit(self, images, labels=None, *, tier: int = 0,
-               slo_ms: Optional[float] = None) -> Future:
+               slo_ms: Optional[float] = None, ctx=None) -> Future:
         """Accept one request; returns a Future resolving to a ``Reply``.
         Raises ``QueueFull`` (with a retry-after hint) when the bounded
-        queue cannot take it."""
+        queue cannot take it.  ``ctx`` is the upstream ``TraceContext``
+        (the frontend hop's), threaded into dispatch-time spans."""
         req = make_request(images, labels, tier=tier, slo_ms=slo_ms,
-                           max_batch=self.engine.max_batch)
+                           max_batch=self.engine.max_batch, ctx=ctx)
         return self.enqueue(req)
 
     def enqueue(self, req: SchedRequest) -> Future:
@@ -523,7 +533,11 @@ class SLOScheduler:
             else:
                 self._pending.append(req)
                 self._pending_images += req.n
+                depth = self._pending_images
                 self._cond.notify_all()
+        if hint is None and tel.enabled:
+            # Queue-depth watermark signal for the alert engine.
+            tel.gauge("serve_queue_depth", depth, replica=self.replica)
         if hint is not None:
             if tel.enabled:
                 tel.counter("serve_overload", tier=req.tier,
@@ -600,6 +614,8 @@ class SLOScheduler:
                 if item is None:
                     return
                 adm, now = item
+                if adm.deferred:
+                    self._note_deferred(adm.deferred, now)
                 if adm.shed:
                     self._resolve_shed(adm.shed, now)
                 if adm.batch:
@@ -633,6 +649,18 @@ class SLOScheduler:
             # (an install may device_put / take its time — admission and
             # enqueue must not stall behind it).
             self._run_installs(installs)
+
+    def _note_deferred(self, deferred, now: float) -> None:
+        """Stamp first-deferral time on requests miss-repair pushed back
+        to the queue — at dispatch the deferral renders as the
+        ``sched_defer`` slice of their queue wait."""
+        tel = self.telemetry
+        for r in deferred:
+            if r.t_defer is None:
+                r.t_defer = now
+            if tel.enabled:
+                tel.counter("serve_deferred", tier=r.tier,
+                            replica=self.replica)
 
     def _resolve_shed(self, shed, now: float) -> None:
         tel = self.telemetry
@@ -705,6 +733,20 @@ class SLOScheduler:
                 if not met:
                     tel.counter("serve_deadline_miss", tier=r.tier,
                                 replica=self.replica)
+                if r.ctx is not None:
+                    # The scheduler hop's spans, parented under the
+                    # frontend's context: queue wait (arrival ->
+                    # dispatch) and, when miss repair pushed the request
+                    # back, the deferred slice of that wait.
+                    tel.span_event("sched_queue", r.t_arrival,
+                                   t0 - r.t_arrival, trace=r.trace,
+                                   tier=r.tier, replica=self.replica,
+                                   bucket=bucket,
+                                   **r.ctx.child("sched").attrs())
+                    if r.t_defer is not None:
+                        tel.span_event("sched_defer", r.t_defer,
+                                       t0 - r.t_defer, trace=r.trace,
+                                       **r.ctx.child("sched").attrs())
             if r.future is not None and not r.future.done():
                 r.future.set_result(Reply(
                     status="ok" if met else "late", trace=r.trace,

@@ -755,8 +755,56 @@ def test_lint_waivers():
         == ["lock-ownership"]
 
 
+_SRC_SPAN_BARE = """\
+def emit(tel, t0, ctx):
+    tel.span_event("sched_queue", t0, 0.01, bucket=4)
+"""
+
+_SRC_SPAN_SPLAT = """\
+def emit(tel, t0, ctx):
+    tel.span_event("sched_queue", t0, 0.01, bucket=4, **ctx.attrs())
+"""
+
+
+def test_lint_span_hygiene_traced_names():
+    # A distributed-trace span without its join keys is invisible to the
+    # cross-process aggregation — the rule catches the emit site.
+    bad = pylint_rules.lint_source(_SRC_SPAN_BARE, "bad.py")
+    assert [f.rule for f in bad] == ["span-hygiene"]
+    assert "sched_queue" in bad[0].message
+    # **ctx.attrs() splat satisfies it; so does an explicit trace_id=.
+    assert pylint_rules.lint_source(_SRC_SPAN_SPLAT, "ok.py") == []
+    explicit = _SRC_SPAN_BARE.replace("bucket=4", "trace_id=tid")
+    assert pylint_rules.lint_source(explicit, "ok.py") == []
+    # Splatting a LOCAL assigned from .attrs() counts too (the frontend
+    # builds attrs dicts before adding reply fields).
+    via_var = ("def emit(tel, t0, ctx):\n"
+               "    attrs = ctx.attrs()\n"
+               "    attrs['status'] = 'ok'\n"
+               "    tel.span_event('frontend_request', t0, 0.01, **attrs)\n")
+    assert pylint_rules.lint_source(via_var, "ok.py") == []
+    # Non-traced span names are out of scope entirely.
+    other = _SRC_SPAN_BARE.replace("sched_queue", "host_augment")
+    assert pylint_rules.lint_source(other, "ok.py") == []
+
+
+def test_lint_span_hygiene_batch_names_and_waiver():
+    # Batch-level engine spans cover a whole dispatch: they need the
+    # member batcher trace ids (traces=) instead of one trace_id.
+    bad = ("def emit(tel, t0):\n"
+           "    tel.span_event('serve_dispatch', t0, 0.01, bucket=8)\n")
+    finds = pylint_rules.lint_source(bad, "bad.py")
+    assert [f.rule for f in finds] == ["span-hygiene"]
+    assert "traces=" in finds[0].message
+    ok = bad.replace("bucket=8", "traces=list(ids)")
+    assert pylint_rules.lint_source(ok, "ok.py") == []
+    waived = bad.replace(
+        "bucket=8)", "bucket=8)  # lint: ok(span-hygiene)")
+    assert pylint_rules.lint_source(waived, "w.py") == []
+
+
 def test_repo_lints_clean():
-    """Tier-1 gate: the shipped tree carries none of the three hazards
+    """Tier-1 gate: the shipped tree carries none of the four hazards
     (same check tools/lint_graft.py runs standalone)."""
     targets = [os.path.join(REPO, t) for t in pylint_rules.DEFAULT_TARGETS]
     findings = pylint_rules.lint_paths(targets)

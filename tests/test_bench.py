@@ -401,6 +401,16 @@ def test_emit_head_budget_with_committed_serving_load(tmp_path):
     assert result["hotswap"]["zero_recompiles"] is True
     for name in ("rolling", "all_at_once"):
         assert result["hotswap"][name]["unresolved"] == 0
+    # Round 12: the tracing section honors ITS contracts — capacity
+    # with tracing on within the 5% overhead budget, and the committed
+    # two-process run reconstructed complete skew-corrected waterfalls.
+    tracing = result["tracing"]
+    assert tracing["capacity"]["within_budget"] is True
+    assert tracing["capacity"]["overhead_frac"] <= 0.05
+    two = tracing["two_process"]
+    assert two["complete"] > 0
+    assert any(p["skew_pairs"] > 0 for p in two["skew"].values())
+    assert two["aggregate_wall_s"] < 10.0
     lines = []
     head = bench.emit_result(result, str(tmp_path / "FULL.json"),
                              out=lines.append)
@@ -410,6 +420,7 @@ def test_emit_head_budget_with_committed_serving_load(tmp_path):
     assert parsed == head
     assert "serving_load" not in parsed
     assert "hotswap" not in parsed
+    assert "tracing" not in parsed
     assert json.loads((tmp_path / "FULL.json").read_text()) == result
 
 

@@ -3,9 +3,10 @@
 
 Enforces the repo's concurrency/measurement invariants statically:
 un-fenced timing around device dispatches, jnp on producer/batcher
-threads, and shared-state writes outside the owning lock.  Exits nonzero
-on any finding, so it slots into CI as-is; tests/test_analysis.py runs
-the same check as a tier-1 test.
+threads, shared-state writes outside the owning lock, and
+distributed-trace spans emitted without their join keys
+(span-hygiene).  Exits nonzero on any finding, so it slots into CI
+as-is; tests/test_analysis.py runs the same check as a tier-1 test.
 
     python tools/lint_graft.py              # lint the default targets
     python tools/lint_graft.py serve ft     # lint specific paths

@@ -41,9 +41,12 @@ def _parse_tiers(spec):
 
 
 def gen_trace(args) -> dict:
+    sizes = demo.SIZE_CHOICES
+    if args.max_size is not None:
+        sizes = tuple(s for s in sizes if s <= args.max_size)
     trace = demo.synthetic_load_trace(
         args.requests, offered_rps=args.rps, seed=args.seed,
-        tiers=_parse_tiers(args.tier))
+        size_choices=sizes, tiers=_parse_tiers(args.tier))
     return {
         "trace": [[round(t, 9), n, tier, slo] for t, n, tier, slo in trace],
         "meta": {"requests": args.requests, "offered_rps": args.rps,
@@ -76,10 +79,23 @@ def cmd_replay(args) -> int:
         trace = [tuple(row) for row in doc["trace"]]
         seed = args.seed
     pool = demo.request_pool(seed=123)
-    with FrontendClient((args.host, args.port),
-                        timeout=args.timeout) as client:
-        stats = demo.replay_load(client, trace, pool=pool, seed=seed,
-                                 drain_timeout_s=args.timeout)
+    # --telemetry-out makes this CLIENT process one stream of a
+    # distributed trace: each request gets a root TraceContext riding
+    # the wire extension, and the client-side ``trace_client`` spans
+    # land in our own events.jsonl for tools/trace_waterfall.py to
+    # skew-correct against the server's stream.
+    telemetry = None
+    if args.telemetry_out:
+        from cs744_ddp_tpu.obs import Telemetry
+        telemetry = Telemetry(args.telemetry_out)
+    try:
+        with FrontendClient((args.host, args.port), timeout=args.timeout,
+                            telemetry=telemetry) as client:
+            stats = demo.replay_load(client, trace, pool=pool, seed=seed,
+                                     drain_timeout_s=args.timeout)
+    finally:
+        if telemetry is not None:
+            telemetry.finalize()
     print(json.dumps(stats))
     return 0
 
@@ -99,6 +115,9 @@ def main(argv=None) -> int:
                    metavar="TIER:WEIGHT:SLO_MS",
                    help="tier mixture entry (repeatable; default "
                         "0:2:75 1:5:200 2:3:600)")
+    g.add_argument("--max-size", type=int, default=None, metavar="N",
+                   help="cap request sizes at N images (match the "
+                        "server's largest bucket)")
     g.add_argument("-o", "--out", default=None,
                    help="trace file (default: print one JSON line)")
     g.set_defaults(fn=cmd_gen)
@@ -115,8 +134,13 @@ def main(argv=None) -> int:
     r.add_argument("--seed", type=int, default=0)
     r.add_argument("--tier", action="append", default=None,
                    metavar="TIER:WEIGHT:SLO_MS")
+    r.add_argument("--max-size", type=int, default=None, metavar="N")
     r.add_argument("--timeout", type=float, default=120.0,
                    help="drain timeout seconds")
+    r.add_argument("--telemetry-out", default=None, metavar="DIR",
+                   help="write client-side trace spans (events.jsonl) "
+                        "here; enables distributed tracing on every "
+                        "request via the wire extension")
     r.set_defaults(fn=cmd_replay)
 
     args = p.parse_args(argv)

@@ -311,6 +311,59 @@ def _publish_lines(events) -> list:
     return lines
 
 
+def _waterfall_lines(out_dir: str, events) -> list:
+    """Distributed-trace rendering (round 12, ``obs/aggregate.py``): when
+    the run carries ``trace_id``-stamped spans, reconstruct this one
+    stream's request waterfalls (single-process view — use
+    tools/trace_waterfall.py across N run dirs for the skew-corrected
+    cross-process merge).  Returns [] for untraced runs."""
+    if not any(e.get("kind") == "span" and e.get("trace_id")
+               for e in events):
+        return []
+    from cs744_ddp_tpu.obs import aggregate as agg
+    rep = agg.aggregate_streams(
+        [agg.ProcessStream(os.path.basename(os.path.normpath(out_dir))
+                           or out_dir, events)])
+    lines = ["== waterfall (distributed traces, this stream) =="]
+    lines.append(f"  traces                 {rep['traces']} "
+                 f"({rep['complete']} complete, {rep['orphaned']} "
+                 f"orphaned/partial)")
+    for stage, a in rep["stage_ms"].items():
+        lines.append(f"  {stage:<16} x{a['count']:<6} "
+                     f"p50 {a['p50']:8.2f} ms  p99 {a['p99']:8.2f} ms")
+    dom = rep["critical_path"].get("dominant")
+    if dom:
+        share = rep["critical_path"]["share"].get(dom)
+        lines.append(f"  critical path          {dom} "
+                     f"({share:.0%} of stage time)")
+    lines.append("")
+    return lines
+
+
+def _alert_lines(events) -> list:
+    """Alert-engine rendering (round 12, ``obs/alerts.py``): structured
+    ``kind: alert`` events grouped by deterministic rule id.  Returns []
+    for runs with no alerts — quiet runs render unchanged."""
+    by_rule = {}
+    for e in events:
+        if e.get("kind") != "alert":
+            continue
+        agg = by_rule.setdefault(e.get("rule", "?"), {
+            "count": 0, "severity": e.get("severity", "?"),
+            "first_t": e.get("t")})
+        agg["count"] += 1
+        agg["last_t"] = e.get("t")
+    if not by_rule:
+        return []
+    lines = ["== alerts =="]
+    for rule, agg in sorted(by_rule.items()):
+        span_s = (agg["last_t"] or 0) - (agg["first_t"] or 0)
+        lines.append(f"  {rule:<14} [{agg['severity']}]  x{agg['count']:<5}"
+                     f" over {span_s:.1f} s")
+    lines.append("")
+    return lines
+
+
 def render(out_dir: str) -> str:
     manifest, events, summary = read_run(out_dir)
     # A preempted/killed run legitimately truncates the final event line;
@@ -380,6 +433,8 @@ def render(out_dir: str) -> str:
     lines.extend(_trace_lines(events))
     lines.extend(_slo_lines(events))
     lines.extend(_publish_lines(events))
+    lines.extend(_waterfall_lines(out_dir, events))
+    lines.extend(_alert_lines(events))
 
     gauges = {}
     for e in events:

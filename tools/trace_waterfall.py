@@ -1,0 +1,152 @@
+"""Merge N telemetry run directories into cross-process latency waterfalls.
+
+Each process in a traced serving run (``--trace`` on the server, the
+client, the load generator) writes spans carrying ``trace_id`` /
+``span_id`` / ``parent_span_id`` into its OWN ``events.jsonl``.  This
+tool stitches them back together (``cs744_ddp_tpu/obs/aggregate.py``):
+clock skew per process via the NTP midpoint method (error bounded by
+half the measured round trip), per-request stage waterfalls (wire
+decode, queue wait, admit deferral, staging, device compute, fetch,
+reply encode), per-stage p50/p99 attribution, critical-path shares, and
+— with ``--prior-flops`` — the device-compute stage measured against
+the HLO cost-model prior.
+
+Pure python over jsonl: safe to run on a machine with no jax installed.
+
+Run:  python tools/trace_waterfall.py RUN_DIR [RUN_DIR ...]
+          [--json] [--reference NAME] [--max-waterfalls N]
+          [--prior-flops FILE.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cs744_ddp_tpu.obs import aggregate as agg  # noqa: E402
+
+_BAR_WIDTH = 40
+
+
+def _bars(stages: dict) -> list:
+    """One waterfall's stages as proportional ASCII bars."""
+    total = sum(stages.values()) or 1e-9
+    lines = []
+    for stage in agg.STAGE_ORDER:
+        if stage not in stages:
+            continue
+        ms = stages[stage]
+        n = max(1, int(round(_BAR_WIDTH * ms / total)))
+        lines.append(f"    {stage:<16} {'#' * n:<{_BAR_WIDTH}} "
+                     f"{ms:9.3f} ms")
+    return lines
+
+
+def render(report: dict) -> str:
+    lines = ["cross-process trace waterfall", ""]
+    lines.append("== processes ==")
+    for name, p in sorted(report["processes"].items()):
+        if name == report.get("reference"):
+            skew = "reference clock"
+        elif p["skew_estimated"]:
+            skew = (f"offset {p['clock_offset_s'] * 1e3:+.3f} ms "
+                    f"(+/- {p['rtt_bound_s'] * 1e3:.3f} ms, "
+                    f"{p['skew_pairs']} pairs)")
+        else:
+            skew = "no skew estimate (no matched request pairs)"
+        bad = f"  !! {p['bad_lines']} bad lines" if p["bad_lines"] else ""
+        lines.append(f"  {name:<20} {p['events']:>7} events  {skew}{bad}")
+    lines.append("")
+
+    lines.append(f"== traces ==")
+    lines.append(f"  reconstructed          {report['traces']} "
+                 f"({report['complete']} complete, "
+                 f"{report['orphaned']} orphaned/partial)")
+    res = report.get("client_minus_stages_ms")
+    if res:
+        lines.append(f"  client - stage sum     p50 {res['p50']:+.3f} ms  "
+                     f"p99 {res['p99']:+.3f} ms "
+                     f"(wire + scheduling residual)")
+    lines.append("")
+
+    if report["stage_ms"]:
+        lines.append("== stage attribution (all traces) ==")
+        for stage, a in report["stage_ms"].items():
+            lines.append(f"  {stage:<16} x{a['count']:<6} "
+                         f"p50 {a['p50']:8.3f} ms  "
+                         f"p99 {a['p99']:8.3f} ms  "
+                         f"mean {a['mean']:8.3f} ms")
+        dom = report["critical_path"].get("dominant")
+        if dom:
+            share = report["critical_path"]["share"].get(dom)
+            lines.append(f"  critical path          {dom} "
+                         f"({share:.0%} of stage time)")
+        lines.append("")
+
+    prior = report.get("cost_prior")
+    if prior:
+        lines.append("== device compute vs cost-model prior ==")
+        for b, rec in prior["by_bucket"].items():
+            ratio = rec["measured_over_prior"]
+            lines.append(f"  bucket {b:<6} measured p50 "
+                         f"{rec['measured_ms_p50']:8.3f} ms  prior "
+                         f"{rec['prior_ms']:8.3f} ms  ratio "
+                         f"{ratio if ratio is not None else '-'}")
+        lines.append("")
+
+    for w in report["waterfalls"]:
+        flag = "" if w["complete"] else "  [incomplete]"
+        who = ",".join(w.get("procs", []))
+        lines.append(f"== waterfall trace {w['trace_id']:#x}{flag} "
+                     f"({who}) ==")
+        lines.extend(_bars(w["stages"]))
+        tail = [f"stage sum {w['sum_ms']:.3f} ms"]
+        if w.get("frontend_ms") is not None:
+            tail.append(f"server window {w['frontend_ms']:.3f} ms")
+        if w.get("client_ms") is not None:
+            tail.append(f"client round-trip {w['client_ms']:.3f} ms")
+        lines.append("    " + "  |  ".join(tail))
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="merge N telemetry run dirs into cross-process "
+                    "request waterfalls")
+    p.add_argument("run_dirs", nargs="+",
+                   help="telemetry run directories (one per process)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the aggregation report as JSON")
+    p.add_argument("--reference", default=None,
+                   help="stream name (dir basename) whose clock is the "
+                        "reference; default: the first with server spans")
+    p.add_argument("--max-waterfalls", type=int, default=8,
+                   help="individual waterfalls to render (default 8)")
+    p.add_argument("--prior-flops", default=None, metavar="FILE.json",
+                   help="json {bucket: flops} from the HLO cost model; "
+                        "joins device compute against the analytic prior")
+    args = p.parse_args(argv)
+    for d in args.run_dirs:
+        if not os.path.isdir(d):
+            p.error(f"not a directory: {d}")
+    prior = None
+    if args.prior_flops:
+        with open(args.prior_flops, encoding="utf-8") as f:
+            prior = {int(k): float(v) for k, v in json.load(f).items()}
+    report = agg.aggregate_run_dirs(
+        args.run_dirs,
+        warn=lambda msg: print(f"warning: {msg}", file=sys.stderr),
+        reference=args.reference, prior_flops=prior,
+        max_waterfalls=args.max_waterfalls)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
