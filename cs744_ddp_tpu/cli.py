@@ -299,6 +299,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "programs matching a glob, e.g. "
                          "baked-constants@serve/* (repeatable); waived "
                          "findings are reported but don't fail strict")
+    au.add_argument("--verify-static", action="store_true",
+                    help="run the whole-repo static verification gate "
+                         "and exit: repo lints, the lock-order deadlock "
+                         "detector (certified acquisition order), wire-"
+                         "protocol schema conformance against serve/"
+                         "wire.py, the full program-zoo audit, and the "
+                         "static host-round-trip certificate; prints a "
+                         "JSON summary, exits 2 on any finding")
     return p
 
 
@@ -355,6 +363,61 @@ def audit_main(args, telemetry) -> None:
         auditlib.record_attribution(
             telemetry, auditlib.zoo_attribution(result))
     _apply_audit(args, telemetry, result)
+
+
+def verify_static_main(args, telemetry) -> None:
+    """--verify-static: one gate over every static analyzer.  Repo lints
+    + lock-order deadlock detection + wire schema conformance run first
+    (pure AST, fast); then the full zoo is lowered once and shared by
+    the program audit and the host-round-trip certificate.  The summary
+    lands on stdout as JSON (and in the manifest for enabled recorders);
+    any finding anywhere exits 2 — this is the CI front door
+    tests/test_analysis.py::test_repo_static_verification pins."""
+    import json
+    import os
+
+    from .analysis import audit as auditlib
+    from .analysis import dispatch as dispatchlib
+    from .analysis import lockgraph, wire_schema
+    from .analysis.pylint_rules import DEFAULT_TARGETS, lint_paths
+    from .serve import demo, wire
+    from .utils.metrics import WINDOW
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = lint_paths([os.path.join(repo, t) for t in DEFAULT_TARGETS])
+    graph = lockgraph.build_repo_graph(repo)
+    findings += lockgraph.check_graph(graph)
+    findings += wire_schema.check_wire(repo)
+    result = auditlib.audit_zoo(
+        model=args.model, global_batch=args.batch_size,
+        precision=args.precision,
+        serve_buckets=demo.parse_buckets(args.serve_buckets),
+        serve_precision=args.serve_precision,
+        num_devices=args.num_devices, waive=args.audit_waive or (),
+        metrics_ring=args.metrics_ring != 0, collect_hlo=True)
+    cert = dispatchlib.certify_zoo(result, window=4,
+                                   nbatches=WINDOW + WINDOW // 4,
+                                   include_eval=True)
+    for f in findings:
+        print(f"[verify-static] {f.rule}: {f.path}:{f.line} {f.message}")
+    for line in result.format_lines():
+        print(line)
+    summary = {
+        "clean": (not findings and result.clean and cert["clean"]),
+        "lint_findings": len(findings),
+        "lock_graph": lockgraph.graph_summary(graph),
+        "wire_schema": wire.schema_summary(),
+        "audit": {"clean": result.clean, "n_programs": len(result.reports),
+                  "n_findings": len(result.findings())},
+        "dispatch": cert,
+    }
+    print(json.dumps(summary))
+    auditlib.record_audit(telemetry, result)
+    if getattr(telemetry, "enabled", False):
+        telemetry.update_manifest({"verify_static": {
+            k: summary[k] for k in ("clean", "lint_findings", "audit")}})
+    if not summary["clean"]:
+        raise SystemExit(2)
 
 
 def elastic_main(args, telemetry) -> None:
@@ -550,6 +613,14 @@ def main(argv=None) -> None:
                                    port=args.port)
     telemetry = (Telemetry(args.telemetry_out)
                  if args.telemetry_out is not None else NULL)
+    if args.verify_static:
+        try:
+            verify_static_main(args, telemetry)
+        finally:
+            telemetry.update_manifest(
+                {"compilation_cache": compcache.cache_stats()})
+            telemetry.finalize()
+        return
     if args.audit_zoo:
         try:
             audit_main(args, telemetry)

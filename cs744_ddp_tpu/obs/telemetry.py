@@ -318,10 +318,8 @@ class Telemetry:
             if os.path.exists(src):
                 os.replace(src, f"{base}.{k + 1}{ext}")
         os.replace(self._events_path, f"{base}.1{ext}")
-        # Caller holds _lock (the event() hot path), per the docstring.
-        self._fh = open(self._events_path,  # lint: ok(lock-ownership)
-                        "a", buffering=1)
-        self._event_bytes = 0               # lint: ok(lock-ownership)
+        self._fh = open(self._events_path, "a", buffering=1)
+        self._event_bytes = 0
 
     def step(self, *, epoch: int, iter: int, loss: float, step_time: float,
              forward_time: Optional[float] = None, steady: bool = True,

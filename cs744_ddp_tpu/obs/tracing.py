@@ -103,24 +103,42 @@ def pack_ext(fields: Dict[int, bytes]) -> bytes:
     return b"".join(parts)
 
 
-def unpack_ext(buf: bytes) -> Dict[int, bytes]:
-    """Decode an extension block, skipping unknown tags; a missing,
-    unversioned, or torn block yields ``{}`` (never raises)."""
+# Tags this build understands; anything else is a forward-compat skip.
+KNOWN_TAGS = frozenset({TAG_TRACE, TAG_SERVER_TIMES})
+
+
+def unpack_ext_ex(buf: bytes) -> Tuple[Dict[int, bytes], int, int]:
+    """Decode an extension block -> ``(fields, skipped_unknown, torn)``.
+    Unknown tags are still CARRIED in ``fields`` (skipped by length,
+    uninterpreted — a relay must not strip a newer peer's data) but
+    counted, as is a torn trailing field (dropped).  A missing or
+    unversioned block yields ``({}, 0, 0)``.  Never raises — tracing
+    must never break serving."""
     if len(buf) < _EXT_HEAD.size:
-        return {}
+        return {}, 0, 0
     magic, version = _EXT_HEAD.unpack_from(buf, 0)
     if magic != EXT_MAGIC or version != EXT_VERSION:
-        return {}
+        return {}, 0, 0
     fields: Dict[int, bytes] = {}
+    skipped = torn = 0
     off = _EXT_HEAD.size
     while off + _TLV_HEAD.size <= len(buf):
         tag, n = _TLV_HEAD.unpack_from(buf, off)
         off += _TLV_HEAD.size
         if off + n > len(buf):    # torn trailing field — drop it
+            torn += 1
             break
+        if tag not in KNOWN_TAGS:
+            skipped += 1
         fields[tag] = buf[off:off + n]
         off += n
-    return fields
+    return fields, skipped, torn
+
+
+def unpack_ext(buf: bytes) -> Dict[int, bytes]:
+    """Decode an extension block, skipping unknown tags; a missing,
+    unversioned, or torn block yields ``{}`` (never raises)."""
+    return unpack_ext_ex(buf)[0]
 
 
 def pack_trace(ctx: TraceContext) -> bytes:

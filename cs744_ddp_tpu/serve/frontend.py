@@ -48,7 +48,7 @@ import numpy as np
 from ..obs import NULL
 from ..obs.tracing import (TAG_SERVER_TIMES, TAG_TRACE, TraceContext,
                            pack_ext, pack_server_times, pack_trace,
-                           unpack_ext, unpack_server_times, unpack_trace)
+                           unpack_ext_ex, unpack_server_times, unpack_trace)
 from .batcher import QueueFull
 
 IMAGE_BYTES = 32 * 32 * 3
@@ -71,20 +71,29 @@ MAX_FRAME = _REQ.size + 65535 * IMAGE_BYTES + 4096
 # -- codec ------------------------------------------------------------------
 
 
-def _split_ext(body: bytes, fixed: int, what: str) -> Tuple[bytes, dict]:
+def _split_ext(body: bytes, fixed: int, what: str,
+               telemetry=None) -> Tuple[bytes, dict]:
     """Split a frame body into (fixed-layout bytes, decoded extension
     fields).  Trailing bytes must be a versioned extension block
-    (``unpack_ext`` magic-gates them) — anything else is a torn frame
-    and still fails decode, exactly as the pre-extension codec did."""
+    (``unpack_ext_ex`` magic-gates them) — anything else is a torn frame
+    and still fails decode, exactly as the pre-extension codec did.
+    Unknown tags and dropped torn fields are counted into the
+    ``wire_ext_skipped`` counter when a telemetry sink is supplied —
+    a newer peer's fields silently falling on the floor is exactly the
+    cross-version drift the operator needs to see."""
     if len(body) < fixed:
         raise ValueError(f"{what} body {len(body)} B < {fixed} B")
     tail = body[fixed:]
     if not tail:
         return body, {}
-    fields = unpack_ext(tail)
+    fields, skipped, torn = unpack_ext_ex(tail)
     if not fields:
         raise ValueError(f"{what} body {len(body)} B != {fixed} B "
                          "(trailing bytes are not an extension block)")
+    if (skipped or torn) and telemetry is not None \
+            and getattr(telemetry, "enabled", False):
+        telemetry.counter("wire_ext_skipped", skipped + torn,
+                          unknown=skipped, torn=torn, frame=what)
     return body[:fixed], fields
 
 
@@ -101,7 +110,7 @@ def encode_request(req_id: int, images: np.ndarray, *, tier: int = 0,
                      slo, n) + images.tobytes() + ext
 
 
-def decode_request_ex(payload: bytes
+def decode_request_ex(payload: bytes, telemetry=None
                       ) -> Tuple[int, np.ndarray, int, Optional[float],
                                  Optional[TraceContext]]:
     """Decode a request frame -> (req_id, images, tier, slo_ms, ctx).
@@ -112,7 +121,7 @@ def decode_request_ex(payload: bytes
     if msg != MSG_INFER:
         raise ValueError(f"unknown message type {msg}")
     body, fields = _split_ext(payload[_REQ.size:], n * IMAGE_BYTES,
-                              "request")
+                              "request", telemetry)
     images = np.frombuffer(body, np.uint8).reshape(n, 32, 32, 3)
     ctx = unpack_trace(fields[TAG_TRACE]) if TAG_TRACE in fields else None
     return req_id, images, tier, (None if slo <= 0 else slo), ctx
@@ -152,12 +161,13 @@ def encode_reply(req_id: int, reply, *, t_recv: Optional[float] = None,
                      -1 if mv is None else int(mv), n) + blob + ext
 
 
-def decode_reply(payload: bytes) -> dict:
+def decode_reply(payload: bytes, telemetry=None) -> dict:
     if len(payload) < _REP.size:
         raise ValueError(f"short reply frame ({len(payload)} B)")
     req_id, status, rcode, trace, retry, qw, svc, mv, n = \
         _REP.unpack_from(payload)
-    body, fields = _split_ext(payload[_REP.size:], n * 40, "reply")
+    body, fields = _split_ext(payload[_REP.size:], n * 40, "reply",
+                              telemetry)
     logits = None
     if n:
         logits = np.frombuffer(body, np.float32).reshape(n, 10).copy()
@@ -321,7 +331,7 @@ class ServingFrontend:
                 t_recv = time.time()
                 try:
                     req_id, images, tier, slo_ms, ctx = \
-                        decode_request_ex(payload)
+                        decode_request_ex(payload, tel)
                 except ValueError:
                     return       # malformed frame: drop the connection
                 # The frontend hop's own context: child of the client's
@@ -492,7 +502,7 @@ class FrontendClient:
             if payload is None:
                 break
             try:
-                reply = decode_reply(payload)
+                reply = decode_reply(payload, self.telemetry)
             except ValueError:
                 break
             with self._lock:

@@ -18,6 +18,15 @@ Four layers, each pinned here:
 * thread-safety regressions the lint's ``lock-ownership`` rule found
   (MicroBatcher.start) and the Watchdog cancel-vs-fire race, locked in
   behaviorally.
+* round-13 whole-program verification — the lock-order deadlock
+  detector (``lockgraph``: repo graph certified acyclic on the declared
+  partial order, ``*_locked`` caller-holds verified), wire-protocol
+  schema conformance (``wire_schema`` against the ``serve/wire.py``
+  table, including a deliberately mismatched encoder fixture and the
+  corruption sweep), and the static host-round-trip certifier
+  (``dispatch``: closed-form bounds matched EXACTLY against the live
+  ``host_round_trips`` counter on all three dispatch paths), folded
+  into one tier-1 gate (``test_repo_static_verification``).
 """
 
 import glob
@@ -35,7 +44,12 @@ import jax.numpy as jnp
 
 from cs744_ddp_tpu import models as model_zoo
 from cs744_ddp_tpu.analysis import audit as auditlib
-from cs744_ddp_tpu.analysis import hlo_ir, pylint_rules, stats
+from cs744_ddp_tpu.analysis import dispatch as dispatchlib
+from cs744_ddp_tpu.analysis import (hlo_ir, lockgraph, pylint_rules, stats,
+                                    wire_schema)
+from cs744_ddp_tpu.obs import Telemetry
+from cs744_ddp_tpu.serve import wire
+from cs744_ddp_tpu.train.loop import Trainer
 from cs744_ddp_tpu.utils import hlo_stats as legacy
 
 from tinynet import tiny_cnn
@@ -490,7 +504,8 @@ def test_certify_ladder_seeded():
 def zoo():
     model_zoo.register_model("tiny", tiny_cnn)
     return auditlib.audit_zoo(model="tiny", global_batch=64, window=3,
-                              serve_buckets=(2,), num_devices=4)
+                              serve_buckets=(2,), num_devices=4,
+                              collect_hlo=True)
 
 
 def test_zoo_audits_clean(zoo):
@@ -826,6 +841,26 @@ def test_lint_graft_cli(tmp_path, monkeypatch, capsys):
     assert "lint_graft: clean" in capsys.readouterr().out
 
 
+def test_lint_graft_cli_json(tmp_path, monkeypatch, capsys):
+    """--json emits a machine-readable findings array (CI annotation)
+    with exit codes unchanged: 1 on findings, 0 clean."""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "tools"))
+    import lint_graft
+    bad = tmp_path / "bad.py"
+    bad.write_text(_SRC_UNLOCKED)
+    assert lint_graft.main(["--json", str(bad)]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert len(payload) == 1
+    (f,) = payload
+    assert set(f) == {"rule", "file", "line", "message"}
+    assert f["rule"] == "lock-ownership" and f["line"] == 13
+    assert f["file"].endswith("bad.py") and "drain" in f["message"]
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    assert lint_graft.main(["--json", str(ok)]) == 0
+    assert json.loads(capsys.readouterr().out) == []
+
+
 # ---------------------------------------------------------------------------
 # Thread-safety regressions (satellite 2): the lock-ownership findings,
 # fixed and locked in behaviorally
@@ -989,3 +1024,447 @@ def test_zoo_shrunk_world_audits_clean():
                                  strategies=("ddp",), paths=("window",),
                                  include_eval=False, num_devices=ndev)
         assert res.clean, "\n".join(res.format_lines())
+
+
+# ---------------------------------------------------------------------------
+# Round 13, analyzer 1: lock-order deadlock detector (analysis/lockgraph)
+# ---------------------------------------------------------------------------
+
+def _fmt(findings):
+    return "\n".join(f"{f.path}:{f.line}: [{f.rule}] {f.message}"
+                     for f in findings)
+
+
+def test_repo_lock_graph_certified():
+    """The whole-package lock graph is acyclic, every edge descends the
+    declared partial order, and the known cross-subsystem edges are
+    actually SEEN (an analyzer that went blind would pass vacuously)."""
+    graph = lockgraph.build_repo_graph(REPO)
+    assert lockgraph.check_graph(graph) == [], _fmt(lockgraph.check_graph(graph))
+    # The five cross-object edges the threaded subsystems really take.
+    for edge in (("WeightWatcher._lock", "SLOScheduler._cond"),
+                 ("WeightWatcher._lock", "Telemetry._lock"),
+                 ("AlertEngine._lock", "Telemetry._lock"),
+                 ("MicroBatcher._cond", "Telemetry._lock"),
+                 ("SLOScheduler._cond", "ServiceModel._lock")):
+        assert edge in graph.edges, sorted(graph.edges)
+    # Every lock the package owns has a declared rank, and every edge
+    # descends it — the certificate BASELINE.md records.
+    order = lockgraph.certified_order(graph)
+    assert set(order) == graph.nodes
+    for src, dst in graph.edges:
+        assert order.index(src) < order.index(dst), (src, dst)
+    summary = lockgraph.graph_summary(graph)
+    json.dumps(summary)   # manifest/--verify-static ready
+    assert summary["certified_order"] == order
+    assert lockgraph.check_locks(REPO) == []
+
+
+_SRC_ABBA = """\
+import threading
+
+class A:
+    def __init__(self, peer):
+        self._lock = threading.Lock()
+        self.peer = peer
+
+    def ping(self):
+        with self._lock:
+            self.peer.poke()
+
+    def poked(self):
+        with self._lock:
+            pass
+
+class B:
+    def __init__(self, peer):
+        self._lock = threading.Lock()
+        self.peer = peer
+
+    def poke(self):
+        with self._lock:
+            self.peer.poked()
+"""
+
+
+def test_lockgraph_detects_abba_cycle():
+    """The seeded positive fixture: A holds its lock calling into B,
+    B holds its lock calling back into A — the classic ABBA shape the
+    detector exists for.  Both the cycle and the order violation fire."""
+    finds = lockgraph.check_source(_SRC_ABBA, "abba.py",
+                                   order=("A._lock", "B._lock"))
+    rules = sorted(f.rule for f in finds)
+    assert "lock-cycle" in rules and "lock-order-violation" in rules
+    # With no declared order the edges are undeclared, and the cycle
+    # still fires — acyclicity does not depend on the order table.
+    finds = lockgraph.check_source(_SRC_ABBA, "abba.py", order=())
+    rules = sorted(f.rule for f in finds)
+    assert "lock-cycle" in rules and "lock-order-undeclared" in rules
+    # Cutting the back-edge (B no longer calls into A) clears it.
+    acyclic = _SRC_ABBA.replace("            self.peer.poked()",
+                                "            pass")
+    assert lockgraph.check_source(acyclic, "ok.py",
+                                  order=("A._lock", "B._lock")) == []
+
+
+_SRC_CALLER_HOLDS = """\
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def _drain_locked(self):
+        self.items = []
+
+    def good(self):
+        with self._lock:
+            self._drain_locked()
+
+    def also_good_locked(self):
+        self._drain_locked()
+
+    def bad(self):
+        self._drain_locked()
+"""
+
+
+def test_lockgraph_caller_holds_verification():
+    """What makes the lint's *_locked exemption sound: every call site
+    of a *_locked method must hold the class lock (directly, or by being
+    *_locked itself).  An unlocked call is the seeded violation."""
+    finds = lockgraph.check_source(_SRC_CALLER_HOLDS, "w.py", order=())
+    assert [f.rule for f in finds] == ["lock-caller-holds"]
+    assert "bad" in finds[0].message and "_drain_locked" in finds[0].message
+    fixed = _SRC_CALLER_HOLDS.replace(
+        "    def bad(self):\n        self._drain_locked()",
+        "    def bad(self):\n        with self._lock:\n"
+        "            self._drain_locked()")
+    assert lockgraph.check_source(fixed, "w.py", order=()) == []
+
+
+def test_lockgraph_cross_object_locked_call():
+    src = _SRC_CALLER_HOLDS.replace(
+        "    def bad(self):\n        self._drain_locked()",
+        "    def bad(self):\n        pass") + """\
+
+class Z:
+    def __init__(self, w):
+        self._lock = threading.Lock()
+        self.w = w
+
+    def steal(self):
+        self.w._drain_locked()
+"""
+    finds = lockgraph.check_source(src, "z.py", order=())
+    assert [f.rule for f in finds] == ["lock-cross-locked-call"]
+    assert "Z.steal" in finds[0].message
+
+
+def test_lockgraph_consistent_order_is_clean():
+    src = """\
+import threading
+
+class Outer:
+    def __init__(self, tel):
+        self._lock = threading.Lock()
+        self.tel = tel
+
+    def tick(self):
+        with self._lock:
+            self.tel.bump()
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bump(self):
+        with self._lock:
+            pass
+"""
+    assert lockgraph.check_source(
+        src, "ok.py", order=("Outer._lock", "Inner._lock")) == []
+    # The same edge against the INVERTED declaration is a violation.
+    finds = lockgraph.check_source(
+        src, "bad.py", order=("Inner._lock", "Outer._lock"))
+    assert [f.rule for f in finds] == ["lock-order-violation"]
+
+
+# ---------------------------------------------------------------------------
+# Round 13, satellite 1: the lint holding idioms that replaced waivers
+# ---------------------------------------------------------------------------
+
+_SRC_CONDACQ = """\
+import threading
+
+class P:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+
+    def poll(self):
+        if not self._lock.acquire(blocking=False):
+            return
+        try:
+            self.n += 1
+        finally:
+            self._lock.release()
+"""
+
+
+def test_lint_conditional_acquire_idiom():
+    """The watcher's non-blocking poll: after a conditional
+    ``.acquire()`` whose failure arm bails, the rest of the block runs
+    held — no waiver needed.  A write BEFORE the acquire still races."""
+    assert pylint_rules.lint_source(_SRC_CONDACQ, "ok.py") == []
+    bad = _SRC_CONDACQ.replace(
+        "    def poll(self):\n"
+        "        if not self._lock.acquire(blocking=False):",
+        "    def poll(self):\n"
+        "        self.n += 1\n"
+        "        if not self._lock.acquire(blocking=False):")
+    finds = pylint_rules.lint_source(bad, "bad.py")
+    assert [f.rule for f in finds] == ["lock-ownership"]
+    assert "poll" in finds[0].message
+
+
+_SRC_LOCKED_SUFFIX = """\
+import threading
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.gen = 0
+
+    def install(self):
+        with self._lock:
+            self.gen += 1
+            self._reset_locked()
+
+    def _reset_locked(self):
+        self.gen = 0
+"""
+
+
+def test_lint_locked_suffix_idiom():
+    """A ``*_locked`` method's body runs under the caller's lock by
+    contract — the lint trusts the suffix (no waiver), and lockgraph
+    verifies every call site (previous tests).  Without the suffix the
+    same write is flagged."""
+    assert pylint_rules.lint_source(_SRC_LOCKED_SUFFIX, "ok.py") == []
+    assert lockgraph.check_source(_SRC_LOCKED_SUFFIX, "ok.py",
+                                  order=()) == []
+    bad = _SRC_LOCKED_SUFFIX.replace("_reset_locked", "_reset")
+    finds = pylint_rules.lint_source(bad, "bad.py")
+    assert [f.rule for f in finds] == ["lock-ownership"]
+    assert "_reset" in finds[0].message
+
+
+def test_no_lock_ownership_waivers_left():
+    """Satellite 1's acceptance bar: the idioms above replaced every
+    ``# lint: ok(lock-ownership)`` waiver in the tree."""
+    hits = []
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(REPO, "cs744_ddp_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            if "lint: ok(lock-ownership)" in _read(path):
+                hits.append(path)
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
+# Round 13, analyzer 2: wire-protocol schema conformance (wire_schema)
+# ---------------------------------------------------------------------------
+
+def test_repo_wire_schema_conformance():
+    """Every pack/unpack site in the covered modules agrees with the
+    serve/wire.py table, the live constants match it, and the schema
+    summary is manifest-ready."""
+    finds = wire_schema.check_wire(REPO)
+    assert finds == [], _fmt(finds)
+    assert wire.verify_runtime() == []
+    summary = wire.schema_summary()
+    json.dumps(summary)
+    assert [f["fmt"] for f in summary["frames"]] == ["<IBBdH", "<IBBQdddiH"]
+    assert {f["name"] for f in summary["frames"]} == {"request", "reply"}
+
+
+_SRC_BAD_ENCODER = """\
+import struct
+
+_LEN = struct.Struct("<I")
+_REQ = struct.Struct("<IBBdI")
+"""
+
+
+def test_wire_detects_mismatched_encoder():
+    """The deliberately mismatched encoder: _REQ widened its count field
+    (H -> I) without touching the schema table — the drift one peer
+    ships and the other cannot parse."""
+    finds = wire_schema.check_source(_SRC_BAD_ENCODER, "enc.py")
+    assert [f.rule for f in finds] == ["wire-format-mismatch"]
+    assert "_REQ" in finds[0].message and "<IBBdH" in finds[0].message
+    fixed = _SRC_BAD_ENCODER.replace("<IBBdI", "<IBBdH")
+    assert wire_schema.check_source(fixed, "enc.py") == []
+
+
+def test_wire_detects_unregistered_and_tag_drift():
+    src = ("import struct\n"
+           "_SNEAK = struct.Struct(\"<QQ\")\n"
+           "n = struct.calcsize(\"<QQ\")\n"
+           "TAG_TRACE = 9\n"
+           "TAG_NEW = 1\n"
+           "TAG_DUP = 1\n")
+    rules = sorted(f.rule for f in wire_schema.check_source(src, "m.py"))
+    assert rules == ["wire-tag-dup", "wire-tag-mismatch",
+                     "wire-unregistered-format", "wire-unregistered-format",
+                     "wire-unregistered-tag", "wire-unregistered-tag"]
+
+
+def test_wire_ext_parser_total_static_and_dynamic():
+    """The optional-extension parser must be TOTAL — statically (no
+    raise, every unpack length-guarded) and dynamically (exhaustive
+    truncation + byte-flip sweep over the live function)."""
+    raising = ("def unpack_ext(buf):\n"
+               "    if len(buf) < 2:\n"
+               "        raise ValueError('short')\n"
+               "    return {}\n")
+    finds = wire_schema.check_ext_parser_total(raising, "t.py")
+    assert [f.rule for f in finds] == ["wire-ext-raise"]
+    unguarded = ("def unpack_ext(buf):\n"
+                 "    tag, n = _TLV_HEAD.unpack_from(buf, 0)\n"
+                 "    return {tag: n}\n")
+    finds = wire_schema.check_ext_parser_total(unguarded, "t.py")
+    assert [f.rule for f in finds] == ["wire-ext-unguarded"]
+    assert wire_schema.ext_parse_corruption_sweep() == []
+
+
+# ---------------------------------------------------------------------------
+# Round 13, analyzer 3: static host-round-trip certifier (dispatch)
+# ---------------------------------------------------------------------------
+
+def test_round_trip_closed_form():
+    b = dispatchlib.epoch_round_trip_bound
+    assert b("step", 25) == 25
+    assert b("step", 25, include_eval=True) == 26
+    assert b("window", 25, 20) == 2
+    assert b("window", 25, 20, include_eval=True) == 3
+    assert b("window", 25, 5) == 5
+    assert b("host_window", 7, 3, tail_batch=True) == 4
+    assert b("eval", 2) == 1 and b("eval", 0) == 0
+    with pytest.raises(ValueError, match="bad bound query"):
+        b("window", 5)             # windowed path needs a window
+    with pytest.raises(ValueError, match="bad bound query"):
+        b("step", -1)
+    with pytest.raises(ValueError, match="unknown dispatch path"):
+        b("warp", 5)
+
+
+def test_dispatch_seeded_violations():
+    """Each certificate rule catches its seeded regression: a windowed
+    program that lowered straight-line, one scanning a different window
+    than the trainer dispatches, and one that stopped donating."""
+    flat = dispatchlib.ProgramCert("train/window/ddp", "window", (), 3)
+    assert [f.rule for f in dispatchlib.check_cert(flat)] \
+        == ["dispatch-no-scan"]
+    drift = dispatchlib.ProgramCert("train/window/ddp", "window", (4,), 3)
+    assert [f.rule for f in dispatchlib.check_cert(drift, expect_window=3)] \
+        == ["dispatch-window-mismatch"]
+    bounce = dispatchlib.ProgramCert("train/window/ddp", "window", (3,), 0)
+    assert [f.rule for f in dispatchlib.check_cert(bounce, expect_window=3)] \
+        == ["dispatch-donation-zero"]
+    good = dispatchlib.ProgramCert("train/window/ddp", "window", (3, 4), 3)
+    assert dispatchlib.check_cert(good, expect_window=3) == []
+    assert good.window == 4 and flat.window is None
+
+
+def test_zoo_dispatch_certificate(zoo):
+    """The certificate over the real lowered zoo: every windowed program
+    scans the dispatched window and donates; the closed-form bounds are
+    recorded per program."""
+    cert = dispatchlib.certify_zoo(zoo, window=3, nbatches=25)
+    assert cert["clean"], json.dumps(cert["findings"], indent=2)
+    progs = cert["programs"]
+    assert set(progs) == set(zoo.hlo)
+    win = progs["train/window/ddp"]
+    assert win["path"] == "window" and win["donated"] > 0
+    assert win["epoch_round_trips"] == dispatchlib.epoch_round_trip_bound(
+        "window", 25, 3, include_eval=True) == 10
+    assert progs["train/step/ddp"]["epoch_round_trips"] == 26
+    assert progs["eval/window"]["path"] == "eval"
+    assert "epoch_round_trips" not in progs["eval/window"]
+    assert progs["serve/b2/f32"]["path"] == "serve"
+    json.dumps(cert)
+    with pytest.raises(ValueError, match="collect_hlo"):
+        dispatchlib.certify_zoo(types.SimpleNamespace(hlo={}),
+                                window=3, nbatches=25)
+
+
+def _trip_trainer(tmp_path, mesh4, telemetry, **kw):
+    return Trainer(model=tiny_cnn(), strategy="ddp", mesh=mesh4,
+                   global_batch=64, data_dir=str(tmp_path), augment=False,
+                   limit_train_batches=25, limit_eval_batches=2,
+                   log=lambda s: None, telemetry=telemetry, **kw)
+
+
+def test_static_round_trip_bound_matches_runtime_exactly(tmp_path, mesh4):
+    """ISSUE 13's acceptance bar: the static closed form equals the live
+    ``host_round_trips`` counter EXACTLY on all three dispatch paths —
+    ring-buffer windowed, plain windowed, and per-step."""
+    from cs744_ddp_tpu.utils.metrics import WINDOW
+    nbatches = 25
+    windowed = dispatchlib.epoch_round_trip_bound(
+        "window", nbatches, WINDOW, include_eval=True)
+
+    tel = Telemetry()
+    tr = _trip_trainer(tmp_path, mesh4, tel, metrics_ring=WINDOW)
+    tr.train_model(0)
+    tr.test_model()
+    assert dispatchlib.total_runtime_trips(tel.records) == windowed == 3
+    assert dispatchlib.count_runtime_trips(tel.records) \
+        == {"window_drain": 2, "eval": 1}
+
+    tel = Telemetry()
+    tr = _trip_trainer(tmp_path, mesh4, tel, metrics_ring=0)
+    tr.train_model(0)
+    tr.test_model()
+    assert dispatchlib.total_runtime_trips(tel.records) == windowed == 3
+    assert dispatchlib.count_runtime_trips(tel.records) \
+        == {"window_fetch": 2, "eval": 1}
+
+    tel = Telemetry()
+    tr = _trip_trainer(tmp_path, mesh4, tel, profile_phases=True)
+    tr.train_model(0)
+    tr.test_model()
+    per_step = dispatchlib.epoch_round_trip_bound(
+        "step", nbatches, include_eval=True)
+    assert dispatchlib.total_runtime_trips(tel.records) == per_step == 26
+    sites = dispatchlib.count_runtime_trips(tel.records)
+    assert sites["step_fetch"] == 25 and sites["eval"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Round 13 tentpole gate: the one tier-1 test CI pins everything on
+# ---------------------------------------------------------------------------
+
+def test_repo_static_verification(zoo):
+    """Folds --audit-zoo, the repo lints, and the three whole-program
+    analyzers into one gate — what ``--verify-static`` runs from the
+    CLI, asserted here as a tier-1 test."""
+    findings = pylint_rules.lint_paths(
+        [os.path.join(REPO, t) for t in pylint_rules.DEFAULT_TARGETS])
+    findings += lockgraph.check_locks(REPO)
+    findings += wire_schema.check_wire(REPO)
+    assert findings == [], _fmt(findings)
+    assert zoo.clean, "\n".join(zoo.format_lines())
+    cert = dispatchlib.certify_zoo(zoo, window=3, nbatches=25)
+    assert cert["clean"], json.dumps(cert["findings"], indent=2)

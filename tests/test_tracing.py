@@ -33,9 +33,10 @@ from cs744_ddp_tpu.data import cifar10
 from cs744_ddp_tpu.ft import ChaosPlan
 from cs744_ddp_tpu.obs import AlertEngine, Telemetry, TraceContext
 from cs744_ddp_tpu.obs import aggregate
+from cs744_ddp_tpu.obs.telemetry import read_events_jsonl
 from cs744_ddp_tpu.obs.tracing import (EXT_MAGIC, TAG_TRACE, new_id,
                                        pack_ext, pack_trace, unpack_ext,
-                                       unpack_trace)
+                                       unpack_ext_ex, unpack_trace)
 from cs744_ddp_tpu.serve import (EngineReplica, LoopbackClient,
                                  ReplicaRouter, ServingFrontend)
 from cs744_ddp_tpu.serve.frontend import (decode_reply, decode_request,
@@ -83,6 +84,86 @@ def test_ext_block_skips_unknown_tags_and_tolerates_torn():
     # Wrong magic/version degrades to "no extension", never raises.
     assert unpack_ext(b"\x00" + blob[1:]) == {}
     assert unpack_ext(b"") == {}
+
+
+def test_ext_block_counts_skipped_and_torn():
+    """Round 13: ``unpack_ext_ex`` COUNTS what forward-compat skipping
+    silently tolerated — unknown tags (still carried) and dropped torn
+    trailing fields — so the codec can surface cross-version drift."""
+    ctx = TraceContext.new_root("client")
+    blob = pack_ext({TAG_TRACE: pack_trace(ctx), 99: b"future-field"})
+    fields, skipped, torn = unpack_ext_ex(blob)
+    assert unpack_trace(fields[TAG_TRACE]) == ctx
+    assert fields[99] == b"future-field" and (skipped, torn) == (1, 0)
+    # Torn trailing field: dropped and counted; earlier fields survive.
+    fields, skipped, torn = unpack_ext_ex(blob[:-1])
+    assert TAG_TRACE in fields and 99 not in fields
+    assert (skipped, torn) == (0, 1)
+    # A clean all-known block counts nothing.
+    clean = pack_ext({TAG_TRACE: pack_trace(ctx)})
+    assert unpack_ext_ex(clean)[1:] == (0, 0)
+    # Missing/unversioned blocks stay zero-count empty, never raising.
+    assert unpack_ext_ex(b"") == ({}, 0, 0)
+    assert unpack_ext_ex(b"\x00" + blob[1:]) == ({}, 0, 0)
+
+
+def test_wire_ext_skipped_counter_emission(pool):
+    """The decoders feed skip/torn counts into the ``wire_ext_skipped``
+    telemetry counter, attributed per frame kind — and clean frames
+    emit nothing."""
+    root = TraceContext.new_root("client")
+    traced = encode_request(4, pool.images[:2], tier=2, slo_ms=25.0,
+                            ctx=root)
+
+    def skips(tel):
+        return [r for r in tel.records if r.get("kind") == "counter"
+                and r.get("name") == "wire_ext_skipped"]
+
+    tel = Telemetry()
+    assert decode_request_ex(traced, tel)[4] == root
+    assert skips(tel) == []                    # same-build frame: silent
+    future = traced + pack_ext({7: b"xyz"})[2:]
+    assert decode_request_ex(future, tel)[4] == root
+    (rec,) = skips(tel)
+    assert (rec["inc"], rec["unknown"], rec["torn"]) == (1, 1, 0)
+    assert rec["frame"] == "request"
+    # A torn trailing field on a reply counts on the reply side; the
+    # known fields still decode.
+    logits = np.arange(20, dtype=np.float32).reshape(2, 10)
+    rep = {"status": "ok", "trace": 5, "logits": logits, "reason": "",
+           "queue_wait_ms": 1.0, "service_ms": 2.0, "retry_after_ms": 0.0}
+    timed = encode_reply(9, rep, t_recv=10.5, t_send=10.75)
+    torn = timed + pack_ext({7: b"xyz"})[2:-1]
+    tel2 = Telemetry()
+    out = decode_reply(torn, tel2)
+    assert (out["t_recv"], out["t_send"]) == (10.5, 10.75)
+    (rec,) = skips(tel2)
+    assert (rec["inc"], rec["unknown"], rec["torn"]) == (1, 0, 1)
+    assert rec["frame"] == "reply"
+
+
+def test_telemetry_report_wire_ext_section(tmp_path, monkeypatch, pool):
+    """tools/telemetry_report surfaces the skip counts as a
+    ``== wire extension skips ==`` section — absent on same-build runs."""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "tools"))
+    import telemetry_report
+    root = TraceContext.new_root("client")
+    traced = encode_request(4, pool.images[:2], tier=2, slo_ms=25.0,
+                            ctx=root)
+    future = traced + pack_ext({7: b"xyz"})[2:]
+    run = tmp_path / "run"
+    tel = Telemetry(str(run))
+    decode_request_ex(future, tel)
+    tel.finalize()
+    text = telemetry_report.render(str(run))
+    assert "== wire extension skips ==" in text
+    assert "request" in text and "unknown tags skipped 1" in text
+
+    plain = tmp_path / "plain"
+    tel2 = Telemetry(str(plain))
+    tel2.step(epoch=0, iter=0, loss=1.0, step_time=0.01)
+    tel2.finalize()
+    assert "wire extension" not in telemetry_report.render(str(plain))
 
 
 def test_wire_request_compat_both_directions(pool):
@@ -213,6 +294,47 @@ def test_aggregate_rotated_and_torn_event_files(tmp_path):
     assert set(w["stages"]) == {"wire_decode", "queue_wait",
                                 "device_compute", "reply_encode"}
     assert w["client_ms"] == pytest.approx(12.0)
+
+
+def test_aggregate_directory_with_only_rotated_generations(tmp_path):
+    """Round 13 satellite: a process killed right after rotation leaves a
+    directory with ONLY ``events.N.jsonl`` generations — no live
+    ``events.jsonl``.  The reader must still yield the generations
+    oldest-first and the multi-directory merge must reconstruct the
+    cross-process waterfall COMPLETE."""
+    root = TraceContext.new_root("client")
+    sched = root.child("sched")
+    d = tmp_path / "server"
+    d.mkdir()
+    gen1 = [_span("wire_decode", 1.0, 0.001, root.child("frontend")),
+            _span("sched_queue", 1.001, 0.002, sched, trace=7, bucket=2)]
+    gen2 = [_span("serve_dispatch", 1.003, 0.004,
+                  TraceContext(0, 0, 0, ""), traces=[7], bucket=2),
+            _span("reply_encode", 1.008, 0.001, root.child("frontend"))]
+    gen2[0].pop("trace_id")       # batch spans carry traces=, not trace_id
+    # Rotation numbers count up from the most recent: .2 is OLDER than .1.
+    (d / "events.2.jsonl").write_text(
+        "\n".join(json.dumps(e) for e in gen1) + "\n")
+    (d / "events.1.jsonl").write_text(
+        "\n".join(json.dumps(e) for e in gen2) + "\n")
+    # No live events.jsonl: the reader tolerates its absence and keeps
+    # generation order.
+    events, bad = read_events_jsonl(str(d / "events.jsonl"))
+    assert bad == 0
+    assert [e["name"] for e in events] == ["wire_decode", "sched_queue",
+                                           "serve_dispatch", "reply_encode"]
+    cli = tmp_path / "client"
+    cli.mkdir()
+    (cli / "events.jsonl").write_text(
+        json.dumps(_span("trace_client", 0.999, 0.012, root, trace=7))
+        + "\n")
+    report = aggregate.aggregate_run_dirs([str(d), str(cli)])
+    assert report["processes"]["server"]["bad_lines"] == 0
+    assert report["traces"] == 1 and report["complete"] == 1
+    (w,) = report["waterfalls"]
+    assert w["complete"] and set(w["procs"]) == {"client", "server"}
+    assert set(w["stages"]) == {"wire_decode", "queue_wait",
+                                "device_compute", "reply_encode"}
 
 
 def test_replica_death_leaves_attributable_orphan(pool):

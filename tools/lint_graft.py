@@ -1,22 +1,36 @@
 #!/usr/bin/env python
-"""Project lint runner: AST rules from cs744_ddp_tpu/analysis/pylint_rules.
+"""Project lint runner: the AST rules from cs744_ddp_tpu/analysis.
 
 Enforces the repo's concurrency/measurement invariants statically:
 un-fenced timing around device dispatches, jnp on producer/batcher
 threads, shared-state writes outside the owning lock, and
 distributed-trace spans emitted without their join keys
-(span-hygiene).  Exits nonzero on any finding, so it slots into CI
-as-is; tests/test_analysis.py runs the same check as a tier-1 test.
+(span-hygiene).  A default (path-less) run also certifies the two
+whole-program analyzers: the lock-order deadlock detector
+(analysis/lockgraph — acyclic acquisition graph on the declared
+partial order, *_locked caller-holds verified) and wire-protocol
+schema conformance (analysis/wire_schema — every struct format/TLV
+tag against serve/wire.py, encoder/decoder symmetry, total
+extension parsing).  Exits nonzero on any finding, so it slots into
+CI as-is; tests/test_analysis.py runs the same checks as a tier-1
+test.
 
-    python tools/lint_graft.py              # lint the default targets
-    python tools/lint_graft.py serve ft     # lint specific paths
+    python tools/lint_graft.py              # lint + lockgraph + wire
+    python tools/lint_graft.py serve ft     # lint specific paths only
+    python tools/lint_graft.py --json       # machine-readable findings
+    python tools/lint_graft.py --dispatch   # + static dispatch certifier
+                                            #   (lowers the zoo: slow,
+                                            #   needs jax)
 
-Waive a line with ``# lint: ok`` or ``# lint: ok(rule-name)``.
+Waive a lint line with ``# lint: ok`` or ``# lint: ok(rule-name)``;
+the whole-program analyzers take no waivers — fix the source or the
+declared order/schema table.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -27,20 +41,58 @@ from cs744_ddp_tpu.analysis.pylint_rules import (DEFAULT_TARGETS,  # noqa: E402
                                                  lint_paths)
 
 
+def _dispatch_findings():
+    """Lower a small zoo and run the static round-trip certifier over
+    it.  Import-gated: only the --dispatch path touches jax."""
+    from cs744_ddp_tpu.analysis import audit, dispatch
+    from cs744_ddp_tpu.analysis.pylint_rules import LintFinding
+    result = audit.audit_zoo(global_batch=64, window=4,
+                             strategies=("single", "ddp"),
+                             collect_hlo=True)
+    cert = dispatch.certify_zoo(result, window=4, nbatches=25)
+    return [LintFinding(f["rule"], f["program"], 0, f["message"])
+            for f in cert["findings"]]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         "lint_graft", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: "
-                         + ", ".join(DEFAULT_TARGETS) + ")")
+                         + ", ".join(DEFAULT_TARGETS)
+                         + ", plus the whole-program analyzers)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON array of "
+                         "{rule, file, line, message} (CI diff "
+                         "annotation); exit codes unchanged")
+    ap.add_argument("--dispatch", action="store_true",
+                    help="also run the static dispatch certifier over a "
+                         "lowered zoo (slow; requires jax)")
     args = ap.parse_args(argv)
-    paths = args.paths or [os.path.join(_REPO_ROOT, t)
-                           for t in DEFAULT_TARGETS]
-    findings = lint_paths(paths)
+    if args.paths:
+        paths = args.paths
+        findings = lint_paths(paths)
+    else:
+        from cs744_ddp_tpu.analysis import lockgraph, wire_schema
+        findings = lint_paths([os.path.join(_REPO_ROOT, t)
+                               for t in DEFAULT_TARGETS])
+        findings += lockgraph.check_locks(_REPO_ROOT)
+        findings += wire_schema.check_wire(_REPO_ROOT)
+    if args.dispatch:
+        findings += _dispatch_findings()
+
+    def rel(path: str) -> str:
+        return (os.path.relpath(path, _REPO_ROOT)
+                if os.path.isabs(path) else path)
+
+    if args.as_json:
+        print(json.dumps([{"rule": f.rule, "file": rel(f.path),
+                           "line": f.line, "message": f.message}
+                          for f in findings], indent=2))
+        return 1 if findings else 0
     for f in findings:
-        print(f"{os.path.relpath(f.path, _REPO_ROOT)}:{f.line}: "
-              f"[{f.rule}] {f.message}")
+        print(f"{rel(f.path)}:{f.line}: [{f.rule}] {f.message}")
     if findings:
         print(f"{len(findings)} finding(s)")
         return 1

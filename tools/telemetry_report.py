@@ -61,6 +61,31 @@ def _serving_lines(events) -> list:
     return lines
 
 
+def _wire_ext_lines(events) -> list:
+    """Wire extension-block health: unknown TLV tags skipped and torn
+    trailing fields dropped by the codec (``wire_ext_skipped`` counter,
+    per frame kind).  Non-zero numbers mean a peer on a different
+    protocol build is talking to this process — the cross-version drift
+    signal ROADMAP item 1 needs.  Returns [] when no frame ever skipped
+    a field — same-build runs are unchanged."""
+    per = {}
+    for e in events:
+        if e.get("kind") == "counter" and e.get("name") == "wire_ext_skipped":
+            key = e.get("frame", "?")
+            unknown, torn = per.get(key, (0, 0))
+            per[key] = (unknown + e.get("unknown", 0),
+                        torn + e.get("torn", 0))
+    if not per:
+        return []
+    lines = ["== wire extension skips =="]
+    for frame in sorted(per):
+        unknown, torn = per[frame]
+        lines.append(f"  {frame:<10} unknown tags skipped {unknown:<6} "
+                     f"torn fields dropped {torn}")
+    lines.append("")
+    return lines
+
+
 def _elastic_lines(events, manifest) -> list:
     """Elastic-mode rendering (``--elastic`` runs): per-rank step-time
     percentiles from the raw ``rank_step_time_s`` gauges, straggler flags,
@@ -425,6 +450,8 @@ def render(out_dir: str) -> str:
         for name, total in sorted(summary["counters"].items()):
             lines.append(f"  {name:<34} {total}")
         lines.append("")
+
+    lines.extend(_wire_ext_lines(events))
 
     lines.extend(_serving_lines(events))
     lines.extend(_elastic_lines(events, manifest))
