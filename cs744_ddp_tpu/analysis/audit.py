@@ -45,6 +45,12 @@ tests/test_analysis.py):
 - ``baked-constants`` — no single constant larger than the contract's
   ``max_constant_bytes`` baked into the executable (weights and data
   must arrive as arguments, not literals).
+- ``ingest-edge`` — programs declaring ``u8_edge`` (the serving ladder's
+  fused-ingest rungs) must take the raw uint8 image bytes as an entry
+  parameter and convert them to float IN-program: a float image-shaped
+  entry parameter means the normalize leaked back to the host (one
+  full-size f32 copy per request), and a missing u8->float convert
+  means the program isn't consuming the wire bytes it claims to.
 
 Waiver syntax (CLI ``--audit-waive``, bench, tests): ``RULE`` waives a
 rule everywhere, ``RULE@GLOB`` only for programs matching the fnmatch
@@ -96,6 +102,9 @@ class ProgramContract:
     compress_ratio: float = 1.0          # required param_bytes / grad wire
     aux_bytes: int = 0                   # non-gradient collective allowance
                                          # (BN pmean, loss psum, int8 pmax)
+    u8_edge: bool = False                # fused-ingest contract: uint8
+                                         # images at the program edge,
+                                         # normalize in-program
 
 
 @dataclass
@@ -364,12 +373,63 @@ def _rule_baked_constants(module: hlo_ir.Module, jaxpr,
     return out
 
 
+_IMG_SHAPE_RE = re.compile(r"\b(u8|f16|bf16|f32|f64)\[\d+,32,32,3\]")
+_FLOAT_DTYPES = ("f16", "bf16", "f32", "f64")
+
+
+def _rule_ingest_edge(module: hlo_ir.Module, jaxpr,
+                      c: ProgramContract) -> List[Finding]:
+    if not c.u8_edge:
+        return []
+    out: List[Finding] = []
+    entry = module.entry_computation
+    if entry is None:
+        return [Finding("ingest-edge", c.name,
+                        "program has no entry computation to certify")]
+    u8_img = False
+    for ins in entry.instructions.values():
+        if ins.opcode != "parameter":
+            continue
+        m = _IMG_SHAPE_RE.search(ins.result_type)
+        if m is None:
+            continue
+        if m.group(1) == "u8":
+            u8_img = True
+        else:
+            out.append(Finding(
+                "ingest-edge", c.name,
+                f"{m.group(1)} image-shaped entry parameter {ins.name!r} "
+                f"({ins.result_type}): the wire-to-device path must stay "
+                f"uint8 — a float image input means the normalize left "
+                f"the program and the host pays a 4x transfer"))
+    if not u8_img:
+        out.append(Finding(
+            "ingest-edge", c.name,
+            "no uint8 image-shaped entry parameter: a fused-ingest rung "
+            "must take the raw u8 wire bytes at the program edge"))
+        return out
+    types = {ins.name: ins.result_type for ins in module.instructions()}
+    converted = any(
+        ins.opcode == "convert"
+        and _result_dtype(ins) in _FLOAT_DTYPES
+        and any(types.get(op, "").lstrip().startswith("u8[")
+                for op in ins.operands)
+        for ins in module.instructions())
+    if not converted:
+        out.append(Finding(
+            "ingest-edge", c.name,
+            "no in-program u8 -> float convert: the program takes uint8 "
+            "images but never normalizes them on device"))
+    return out
+
+
 RULES = {
     "collective-contract": _rule_collective_contract,
     "dtype-leak": _rule_dtype_leak,
     "donation": _rule_donation,
     "host-sync": _rule_host_sync,
     "baked-constants": _rule_baked_constants,
+    "ingest-edge": _rule_ingest_edge,
 }
 
 
@@ -734,7 +794,9 @@ def audit_serving(*, model: str = "vgg11",
                   swap_recert: bool = False, swap_seed: int = 1,
                   ) -> List[AuditReport]:
     """Audit the serving executable ladder: one single-device program per
-    bucket, required collective-free, precision-certified, constant-lean.
+    bucket, required collective-free, precision-certified, constant-lean,
+    and fused-ingest certified (``ingest-edge``: uint8 images at the
+    program edge, normalize in-program, no float image inputs).
     Pass ``engine`` to audit an already-built :class:`InferenceEngine`
     (the bench serving section does); otherwise one is built without
     staging or caches.  ``hlo_out`` (a dict) collects each rung's
@@ -761,7 +823,8 @@ def audit_serving(*, model: str = "vgg11",
             name = f"{prefix}/b{b}/{precision}"
             c = ProgramContract(
                 name=name, strategy=None, world=1,
-                precision=precision, max_constant_bytes=max_constant_bytes)
+                precision=precision, max_constant_bytes=max_constant_bytes,
+                u8_edge=True)
             text = engine.lowered_hlo(b, precision)
             reports.append(audit_program(text, c, waive=waive))
             if hlo_out is not None:

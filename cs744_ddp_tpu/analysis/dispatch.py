@@ -48,6 +48,33 @@ TRIP_SITES = ("step_fetch", "window_fetch", "window_drain", "eval")
 #: Paths whose epoch cost is one fetch per WINDOW dispatch.
 WINDOWED_PATHS = ("window", "host_window")
 
+#: Serving-ladder zoo prefixes (``serve/b{bucket}/{precision}`` and the
+#: hot-swap recert twin).  A serving rung must be STRAIGHT-LINE: one
+#: dispatch = one fetch, no internal scan trips.  That is the premise of
+#: the pipelined scheduler's two-in-flight bound — if a rung hid a host
+#: round-trip inside a loop, overlapping two of them would serialize on
+#: the host and the occupancy accounting would lie.
+SERVING_PATHS = ("serve", "serve_swap")
+
+
+def serving_inflight_bound() -> int:
+    """The static per-replica in-flight dispatch bound (= the scheduler's
+    ``PIPELINE_SLOTS`` = the ``StagedIngest`` arena depth).  Tests pin the
+    runtime occupancy (``max_serving_inflight``) against this exactly."""
+    from ..serve.scheduler import PIPELINE_SLOTS
+    return PIPELINE_SLOTS
+
+
+def max_serving_inflight(records: Iterable[Dict]) -> int:
+    """Max observed pipeline occupancy from a recording telemetry's
+    ``serve_inflight`` gauges — the runtime half of the bound pin (0 when
+    the run never pipelined)."""
+    m = 0
+    for r in records:
+        if r.get("kind") == "gauge" and r.get("name") == "serve_inflight":
+            m = max(m, int(r.get("value", 0)))
+    return m
+
 
 def epoch_round_trip_bound(path: str, nbatches: int, window: int = 0, *,
                            tail_batch: bool = False,
@@ -131,6 +158,12 @@ def check_cert(cert: ProgramCert, *, expect_window: Optional[int] = None
             "dispatch-donation-zero", cert.program, 0,
             f"{cert.program} donates no entry parameters — the carried "
             f"state bounces through host memory every window"))
+    if cert.path in SERVING_PATHS and cert.scan_trips:
+        findings.append(LintFinding(
+            "dispatch-serving-scan", cert.program, 0,
+            f"{cert.program} scans {list(cert.scan_trips)} trips — a "
+            f"serving rung must be straight-line (one dispatch = one "
+            f"fetch), or the pipelined two-in-flight bound is unsound"))
     return findings
 
 

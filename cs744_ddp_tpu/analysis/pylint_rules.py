@@ -64,13 +64,18 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 DEFAULT_TARGETS = ("cs744_ddp_tpu", "tools", "bench.py")
 
 # Calls that put work on an accelerator queue and return before it runs.
+# ``infer_counts_async`` is the serving pipeline's explicit issue half:
+# timing it without its ``complete`` fence measures enqueue, not service.
 DISPATCH_NAMES = frozenset({
     "train_window", "train_step", "train_window_host", "train_step_host",
-    "eval_window", "fwd_window", "infer", "infer_counts"})
-# Calls/conversions that synchronize host and device.
+    "eval_window", "fwd_window", "infer", "infer_counts",
+    "infer_counts_async"})
+# Calls/conversions that synchronize host and device.  ``complete`` is
+# the pipeline's completion fence (engine.complete(handle) blocks until
+# the dispatched program finished).
 FENCE_NAMES = frozenset({
     "block_until_ready", "asarray", "array", "device_get", "item",
-    "result", "_fetch_step"})
+    "result", "_fetch_step", "complete"})
 FENCE_BUILTINS = frozenset({"float", "int", "bool"})
 TIMER_ATTRS = frozenset({"time", "perf_counter", "monotonic"})
 MUTATOR_METHODS = frozenset({

@@ -248,6 +248,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="front-end TCP port (0 = ephemeral; the bound "
                          "address is in the output JSON — tools/"
                          "serve_load.py replays against it)")
+    sv.add_argument("--serve-pipeline", default="on", choices=["on", "off"],
+                    help="double-buffered dispatch pipeline in each "
+                         "replica's scheduler: stage + issue batch N+1 "
+                         "while batch N computes (off = the serial "
+                         "dispatch-fence-reply loop, exactly the round-13 "
+                         "path; only with --serve-frontend)")
     sv.add_argument("--serve-shed", default="on", choices=["on", "off"],
                     help="deadline-aware load shedding in the scheduler "
                          "(off = serve everything, late replies included "
@@ -484,6 +490,7 @@ def serve_frontend_main(args, telemetry) -> None:
     chaos = ft.chaos if ft is not None else NULL_CHAOS
     buckets = demo.parse_buckets(args.serve_buckets)
     shed = args.serve_shed == "on"
+    pipeline = args.serve_pipeline == "on"
     alerts = None
     if telemetry.enabled and args.serve_alerts == "on":
         from .obs import AlertEngine
@@ -499,12 +506,12 @@ def serve_frontend_main(args, telemetry) -> None:
                       buckets=buckets, precision=args.serve_precision,
                       seed=args.serve_seed, telemetry=telemetry,
                       cache_dir=args.serve_cache_dir, chaos=chaos,
-                      shed=shed)
+                      shed=shed, pipeline=pipeline)
         for i in range(max(1, args.serve_replicas))]
     telemetry.write_manifest({
         "mode": "serve-frontend", "model": args.model,
         "buckets": list(buckets), "precision": args.serve_precision,
-        "replicas": len(replicas), "shed": shed,
+        "replicas": len(replicas), "shed": shed, "pipeline": pipeline,
         "slo_ms": args.serve_slo_ms,
         "requests": args.serve_requests, "seed": args.serve_seed,
         "chaos": chaos.spec() if chaos.enabled else [],

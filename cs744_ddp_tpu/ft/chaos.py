@@ -55,6 +55,17 @@ replica's OWN dispatches (``replica_death:dispatch:replica``):
                          dispatch ``step`` (a straggling chip); the
                          least-loaded router routes around it as its
                          measured service EWMA inflates;
+* ``dispatch_fault``   — dispatch ``step``'s device result is discarded
+                         at its COMPLETION fence
+                         (``dispatch_fault:dispatch:replica``) — with the
+                         pipelined scheduler, while dispatch ``step+1``
+                         is already in flight.  The pin: the faulted
+                         batch's requests resolve as explicit errors, the
+                         in-flight successor resolves normally on the
+                         same weights, and recovery is bitwise-identical
+                         to the serial path — a completion fault is
+                         isolated, never a silent drop and never a
+                         replica death;
 * ``swap_mid_batch``   — the replica's weight-watcher probe is invoked
                          INSIDE the dispatch hook of dispatch ``step``
                          (``swap_mid_batch:dispatch:replica``): a
@@ -91,13 +102,15 @@ from typing import List, Optional, Sequence, Tuple
 SITES = ("producer_crash", "put_delay", "put_fail", "corrupt_slot",
          "nonfinite_grad", "preempt", "rank_death", "slow_rank",
          "coordinator_loss", "replica_death", "slow_replica",
-         "publish_torn", "swap_mid_batch", "publish_stale")
+         "publish_torn", "swap_mid_batch", "publish_stale",
+         "dispatch_fault")
 # Sites whose third spec field names the target RANK (elastic/), not a
 # payload seed — same wire format, different interpretation.
 RANK_SITES = ("rank_death", "slow_rank")
 # Sites whose third spec field names the target serving REPLICA and whose
 # step counts that replica's own dispatches (serve/replica.py).
-REPLICA_SITES = ("replica_death", "slow_replica", "swap_mid_batch")
+REPLICA_SITES = ("replica_death", "slow_replica", "swap_mid_batch",
+                 "dispatch_fault")
 # Sites fired by the weight publisher (publish/publisher.py): step counts
 # the publisher's own publishes, the third field is a payload seed.
 PUBLISH_SITES = ("publish_torn", "publish_stale")

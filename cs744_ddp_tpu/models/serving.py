@@ -1,0 +1,55 @@
+"""The fused-ingest serving forward: u8 at the program edge.
+
+Every serving ladder rung (``serve/engine.py``) runs this forward: the
+wire format (uint8 CIFAR rows) is the PROGRAM's input dtype, and the
+u8 -> float normalize (``data/augment.normalize``) happens inside XLA —
+the same transfer-compact idiom the training window uses.  Keeping the
+builder here (not inline in the engine) makes the fused forward a named,
+versioned artifact:
+
+* the audit's ``ingest-edge`` rule certifies each lowered rung against
+  this contract (u8 image parameter, in-program convert, no float image
+  constants baked);
+* ``INGEST_VERSION`` is folded into the engine's executable cache key,
+  so warm-start caches never resurrect an executable compiled against a
+  different ingest scheme (ROADMAP: shared-ladder cache keys must
+  version the fused forward).
+
+The forward masks pad rows by the label = -1 convention
+(``train/step.py::masked_eval_counts``), so serving and eval accounting
+share one definition; with ``train=False`` BatchNorm every row is
+independent of its batchmates, which is what makes bucket padding
+bitwise-invisible.
+"""
+
+from __future__ import annotations
+
+#: Identity of the fused-ingest forward, folded into executable cache
+#: keys.  Bump whenever the program edge changes (dtype, normalize,
+#: masking): a stale warm-start hit across schemes would silently serve
+#: wrong math.
+INGEST_VERSION = "fused-u8-v1"
+
+
+def make_u8_forward(apply_fn, compute_dtype=None):
+    """Build ``forward(params, bn_state, images_u8, labels)`` ->
+    ``(logits f32, loss_sum, correct)`` with the normalize fused at the
+    program edge.
+
+    ``compute_dtype`` casts the normalized activations (bf16 compute);
+    logits always come back f32 so downstream comparison/accounting is
+    precision-independent.
+    """
+    import jax.numpy as jnp
+
+    from ..data import augment as aug
+    from ..train.step import masked_eval_counts, maybe_cast
+
+    def forward(params, bn_state, images_u8, labels):
+        x = maybe_cast(aug.normalize(images_u8), compute_dtype)
+        logits, _ = apply_fn(params, bn_state, x, train=False)
+        logits = logits.astype(jnp.float32)
+        loss_sum, correct = masked_eval_counts(logits, labels)
+        return logits, loss_sum, correct
+
+    return forward

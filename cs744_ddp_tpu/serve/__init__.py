@@ -15,25 +15,32 @@ scheduler with priority-tiered admission and deterministic load shedding
 (``replica``) behind a least-loaded router with death failover
 (``router``), and a socket front-end speaking a length-prefixed binary
 protocol (``frontend``).
+
+Round 14 makes the dispatch a PIPELINE: the engine splits issue from
+completion (``infer_counts_async``/``complete``) and the scheduler keeps
+``PIPELINE_SLOTS`` (= 2, the staging arena depth) dispatches in flight
+per replica, so batch N+1's host work overlaps batch N's device compute
+and the device never idles between buckets.
 """
 
 from .batcher import MicroBatcher, QueueFull, coalesce, plan_batches
 from .cache import ExecutableCache, executable_serialization_supported
-from .engine import BUCKETS, InferenceEngine
+from .engine import BUCKETS, DispatchHandle, InferenceEngine
 from .frontend import FrontendClient, LoopbackClient, ServingFrontend
 from .ingest import StagedIngest
 from .replica import EngineReplica
 from .router import ReplicaRouter
-from .scheduler import (Reply, SchedRequest, ServiceModel, SLOScheduler,
-                        admit, cost_model_weights, make_request,
-                        plan_continuous, plan_drain, virtual_requests)
+from .scheduler import (PIPELINE_SLOTS, Reply, SchedRequest, ServiceModel,
+                        SLOScheduler, admit, cost_model_weights,
+                        make_request, plan_continuous, plan_drain,
+                        virtual_requests)
 
 __all__ = [
-    "BUCKETS", "EngineReplica", "ExecutableCache", "FrontendClient",
-    "InferenceEngine", "LoopbackClient", "MicroBatcher", "QueueFull",
-    "Reply", "ReplicaRouter", "SLOScheduler", "SchedRequest",
-    "ServiceModel", "ServingFrontend", "StagedIngest", "admit", "coalesce",
-    "cost_model_weights", "executable_serialization_supported",
-    "make_request", "plan_batches", "plan_continuous", "plan_drain",
-    "virtual_requests",
+    "BUCKETS", "DispatchHandle", "EngineReplica", "ExecutableCache",
+    "FrontendClient", "InferenceEngine", "LoopbackClient", "MicroBatcher",
+    "PIPELINE_SLOTS", "QueueFull", "Reply", "ReplicaRouter", "SLOScheduler",
+    "SchedRequest", "ServiceModel", "ServingFrontend", "StagedIngest",
+    "admit", "coalesce", "cost_model_weights",
+    "executable_serialization_supported", "make_request", "plan_batches",
+    "plan_continuous", "plan_drain", "virtual_requests",
 ]

@@ -30,7 +30,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..data import augment as aug
+from ..models.serving import INGEST_VERSION, make_u8_forward
 from ..obs import NULL
 from ..utils import compcache
 from .cache import ExecutableCache, cache_key
@@ -39,6 +39,25 @@ from .ingest import StagedIngest
 BUCKETS = (1, 8, 32, 128, 256)
 
 _DTYPES = {"f32": None}  # "bf16" resolved lazily (jnp import)
+
+
+class DispatchHandle:
+    """One in-flight asynchronous dispatch (``infer_counts_async``):
+    the device-side result references plus the metadata ``complete``
+    needs to fence, slice, and attribute it.  Opaque to callers."""
+
+    __slots__ = ("logits", "loss_sum", "correct", "n", "bucket", "traces",
+                 "t_issue")
+
+    def __init__(self, logits, loss_sum, correct, n, bucket, traces,
+                 t_issue):
+        self.logits = logits
+        self.loss_sum = loss_sum
+        self.correct = correct
+        self.n = n
+        self.bucket = bucket
+        self.traces = traces
+        self.t_issue = t_issue
 
 
 class InferenceEngine:
@@ -62,8 +81,7 @@ class InferenceEngine:
         import jax.numpy as jnp
 
         from ..models import get_model
-        from ..train.step import init_train_state, masked_eval_counts, \
-            maybe_cast
+        from ..train.step import init_train_state
 
         if not buckets:
             raise ValueError("need at least one bucket")
@@ -104,26 +122,19 @@ class InferenceEngine:
                         if use_staging else None)
         self._jax = jax
 
-        def make_forward(compute_dtype):
-            def forward(params, bn_state, images_u8, labels):
-                x = maybe_cast(aug.normalize(images_u8), compute_dtype)
-                logits, _ = apply_fn(params, bn_state, x, train=False)
-                logits = logits.astype(jnp.float32)
-                loss_sum, correct = masked_eval_counts(logits, labels)
-                return logits, loss_sum, correct
-            return forward
-
-        self._forward = {"f32": make_forward(None),
-                         "bf16": make_forward(jnp.bfloat16)}
+        self._forward = {"f32": make_u8_forward(apply_fn),
+                         "bf16": make_u8_forward(apply_fn, jnp.bfloat16)}
 
         # Everything an executable's identity depends on beyond the bucket
         # and dtype: the abstract model signature (param/bn shapes+dtypes,
-        # not values) and the toolchain/device identity.
+        # not values), the fused-ingest scheme, and the toolchain/device
+        # identity.
         d0 = device if device is not None else jax.devices()[0]
         leaves, treedef = jax.tree_util.tree_flatten(
             (self.params, self.bn_state))
         self._key_fields = {
             "model": model,
+            "ingest": INGEST_VERSION,
             "abstract": (str(treedef),
                          tuple((l.shape, str(l.dtype)) for l in leaves)),
             "jax": jax.__version__,
@@ -330,6 +341,77 @@ class InferenceEngine:
             out = np.asarray(logits)[:n]
             counts = (float(loss_sum), int(correct))
         return out, counts[0], counts[1]
+
+    # -- pipelined dispatch (issue / complete split) ------------------------
+
+    def infer_counts_async(self, images: np.ndarray, labels=None, *,
+                           precision: str = "f32",
+                           trace_ids: Sequence[int] = ()) -> DispatchHandle:
+        """Issue one padded bucket dispatch WITHOUT fencing it.
+
+        jax dispatch is asynchronous: the executable call returns device
+        array futures immediately, so the caller can stage and issue the
+        NEXT batch (the second ``StagedIngest`` slot) while this one
+        computes.  The two-slot arena bounds the depth: at most
+        ``self._ingest.nslots`` dispatches may be in flight before
+        ``complete`` retires one (the scheduler enforces exactly 2,
+        ``scheduler.PIPELINE_SLOTS``).  Resolve with ``complete(handle)``
+        — every issued handle MUST be completed, in issue order, or its
+        result (and its arena slot) is leaked.
+        """
+        images = np.ascontiguousarray(images, np.uint8)
+        n = images.shape[0]
+        bucket = self.bucket_for(n)
+        ex = self._executable(bucket, precision)
+        padded_labels = np.full((bucket,), -1, np.int32)
+        if labels is not None:
+            padded_labels[:n] = np.asarray(labels, np.int32)
+        tel = self.telemetry
+        traces = tuple(trace_ids)
+        if tel.enabled:
+            tel.counter(f"serve_bucket_{bucket}")
+            with tel.span("serve_stage", bucket=bucket, n=n,
+                          traces=list(traces)):
+                staged = self._pad_stage(images, bucket)
+        else:
+            staged = self._pad_stage(images, bucket)
+        t_issue = time.time()
+        logits, loss_sum, correct = ex(self.params, self.bn_state,
+                                       staged, padded_labels)
+        return DispatchHandle(logits, loss_sum, correct, n, bucket,
+                              traces, t_issue)
+
+    def complete(self, handle: DispatchHandle,
+                 prev_done: Optional[float] = None):
+        """Fence one in-flight dispatch and fetch its results.
+
+        Returns ``(logits[n, 10] f32, loss_sum, correct, t_ready)`` —
+        bitwise-identical rows to the serial ``infer_counts`` path (same
+        executable, same staged bytes).  ``prev_done`` (the previous
+        completion's ``t_ready``) clips this dispatch's telemetry span to
+        the window the device actually worked on it: with two in flight,
+        batch N+1's wall interval overlaps batch N's, and the honest
+        per-dispatch occupancy is ``t_ready - max(t_issue, prev_done)``
+        — what the waterfall's device_compute stage and the scheduler's
+        EWMA read.
+        """
+        self._jax.block_until_ready(handle.logits)
+        t_ready = time.time()
+        tel = self.telemetry
+        if tel.enabled:
+            start = handle.t_issue if prev_done is None \
+                else max(handle.t_issue, float(prev_done))
+            tel.span_event("serve_dispatch", start,
+                           max(t_ready - start, 0.0), bucket=handle.bucket,
+                           n=handle.n, traces=list(handle.traces))
+            with tel.span("serve_fetch", bucket=handle.bucket,
+                          traces=list(handle.traces)):
+                out = np.asarray(handle.logits)[:handle.n]
+                counts = (float(handle.loss_sum), int(handle.correct))
+        else:
+            out = np.asarray(handle.logits)[:handle.n]
+            counts = (float(handle.loss_sum), int(handle.correct))
+        return out, counts[0], counts[1], t_ready
 
     def infer(self, images: np.ndarray, *,
               precision: str = "f32") -> np.ndarray:

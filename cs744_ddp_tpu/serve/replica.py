@@ -19,6 +19,13 @@ by ``publish.WeightWatcher``) INSIDE dispatch STEP's hook — a publish
 racing a dispatch already being assembled.  The probe only queues the
 install, so the racing dispatch is answered bitwise by the OLD weights
 and the next by the new — never a mix (pinned in tests/test_publish.py).
+
+``dispatch_fault:STEP:REPLICA`` fires on the scheduler's COMPLETION
+hook instead: dispatch STEP's device result is discarded at its fence
+point (with the pipelined worker, while dispatch STEP+1 is already in
+flight).  The scheduler isolates the fault — STEP's requests get
+explicit error replies, STEP+1 resolves normally on the same weights —
+pinned bitwise against the serial path in tests/test_ft.py.
 """
 
 from __future__ import annotations
@@ -42,7 +49,8 @@ class EngineReplica:
                  svc: Optional[ServiceModel] = None, cost_prior: bool = False,
                  shed: bool = True, max_queue_images: int = 1024,
                  chaos=NULL_CHAOS, slow_stall_s: float = 0.25,
-                 use_staging: bool = True):
+                 use_staging: bool = True,
+                 pipeline: Optional[bool] = None):
         tel = telemetry if telemetry is not None else NULL
         self.index = int(index)
         self.telemetry = tel
@@ -64,7 +72,9 @@ class EngineReplica:
             self.engine, svc=svc, shed=shed,
             max_queue_images=max_queue_images, precision=precision,
             telemetry=tel, replica=self.index,
-            dispatch_hook=self._chaos_hook)
+            dispatch_hook=self._chaos_hook,
+            complete_hook=self._complete_chaos_hook,
+            pipeline=pipeline)
 
     def _chaos_hook(self, dispatch_no: int, bucket: int) -> None:
         ch = self.chaos
@@ -88,6 +98,23 @@ class EngineReplica:
             raise ChaosError(
                 f"chaos: replica {self.index} died at dispatch "
                 f"{dispatch_no} (bucket {bucket})")
+
+    def _complete_chaos_hook(self, dispatch_no: int, bucket: int) -> None:
+        """Completion-side chaos: ``dispatch_fault`` discards dispatch
+        ``dispatch_no``'s result at its fence point.  The scheduler
+        isolates the raise to that one batch (explicit error replies,
+        worker keeps serving) — unlike ``replica_death``, which kills the
+        worker from the issue-side hook."""
+        ch = self.chaos
+        if not ch.enabled:
+            return
+        if dispatch_no in ch.steps("dispatch_fault") \
+                and ch.seed_of("dispatch_fault", dispatch_no) == self.index \
+                and ch.fire("dispatch_fault", dispatch_no):
+            self._note_chaos("dispatch_fault", dispatch_no)
+            raise ChaosError(
+                f"chaos: replica {self.index} dispatch {dispatch_no} "
+                f"(bucket {bucket}) faulted at completion")
 
     def _note_chaos(self, site: str, dispatch_no: int) -> None:
         """Chaos firings are themselves telemetry: trace aggregation

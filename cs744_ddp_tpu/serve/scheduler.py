@@ -24,6 +24,28 @@ it against a real ``InferenceEngine``.  ``plan_continuous`` /
 ``plan_drain`` replay the same policy (and the old drain policy) in
 virtual time over a seeded arrival trace — the deterministic substrate
 for the continuous-vs-drain comparison in bench and tests.
+
+Dispatch pipeline (round 14): with ``pipeline=True`` (the default for
+engines exposing ``infer_counts_async``/``complete``) the worker keeps up
+to ``PIPELINE_SLOTS`` (= 2, the StagedIngest arena depth) dispatches in
+flight: batch N+1 is staged into the second arena slot and issued while
+batch N computes, and completions resolve strictly in issue order.  The
+device never idles between buckets — the host tax (assemble + stage +
+issue + fetch) of batch N+1 overlaps batch N's compute.  Honesty
+obligations that ride along:
+
+* ``admit(free_at=...)`` deadline-checks a second-slot batch against the
+  predicted drain of the work ahead of it, not the admission instant;
+* the EWMA observes per-dispatch DEVICE OCCUPANCY
+  (``t_ready - max(t_issue, prev_done)``), not the overlapped wall
+  interval, so predictions stay additive across slots;
+* weight installs (``request_install``) run only when the pipeline is
+  fully DRAINED — the engine-free instant between in-flight pairs — so
+  the hot-swap A/B pin (no torn weights, per-batch version tag) holds
+  under pipelining;
+* a fault surfacing at completion of slot N (the ``dispatch_fault``
+  chaos site) resolves slot N's requests as explicit errors and slot
+  N+1's normally — never a silent drop.
 """
 
 from __future__ import annotations
@@ -40,6 +62,13 @@ from ..obs import NULL
 from .batcher import QueueFull, next_trace_id, smallest_bucket
 
 _seq_counter = itertools.count(1)
+
+#: Depth of the per-replica dispatch pipeline: one batch computing on
+#: device plus one staged-and-issued behind it.  Matches the two-slot
+#: ``StagedIngest`` arena (reusing a slot is only safe once the dispatch
+#: it fed has been completed); ``analysis/dispatch.py`` certifies the
+#: bound statically and tests pin the runtime occupancy against it.
+PIPELINE_SLOTS = 2
 
 
 class SchedRequest:
@@ -140,7 +169,8 @@ def virtual_requests(trace: Sequence[Tuple[float, int, int, float]]
 def admit(pending: Sequence[SchedRequest], now: float, *,
           buckets: Sequence[int],
           predict_s: Callable[[int], float],
-          shed: bool = True) -> Admission:
+          shed: bool = True,
+          free_at: Optional[float] = None) -> Admission:
     """The continuous-batching admission policy — pure and deterministic.
 
     Orders the queue by ``(tier, deadline, seq)`` (EDF within tier),
@@ -161,7 +191,16 @@ def admit(pending: Sequence[SchedRequest], now: float, *,
     admission — that is the "continuous" part.  With ``shed=False``
     nothing is dropped or deferred: late requests are dispatched anyway
     and reported ``late``.
+
+    ``free_at`` (pipelined two-slot admission) is the predicted wall time
+    the engine frees a slot for THIS batch: predicted completions are
+    measured from ``max(now, free_at)`` instead of ``now``, so a batch
+    admitted into the second in-flight slot is deadline-checked against
+    when it will actually run, not the admission instant.  ``None`` (the
+    serial scheduler, an idle pipeline) keeps the round-13 policy
+    bit-for-bit.
     """
+    start = now if free_at is None else max(now, float(free_at))
     order = sorted(pending, key=lambda r: (r.tier, r.deadline, r.seq))
     shed_list: List[Tuple[SchedRequest, str]] = []
     live: List[SchedRequest] = []
@@ -183,7 +222,7 @@ def admit(pending: Sequence[SchedRequest], now: float, *,
     done = None
     deferred: List[SchedRequest] = []
     while batch:
-        done = now + predict_s(smallest_bucket(buckets, total))
+        done = start + predict_s(smallest_bucket(buckets, total))
         if not shed:
             break
         misses = [r for r in batch if r.deadline < done]
@@ -418,15 +457,27 @@ class SLOScheduler:
     (including the ``replica_death`` chaos site) hands every unfinished
     request to ``on_death`` — the router's failover hook — or resolves
     them as explicit errors when unattended.
+
+    ``pipeline`` selects the double-buffered worker (module docstring):
+    ``None`` auto-enables it when the engine exposes the async dispatch
+    API (``infer_counts_async``/``complete``); ``False`` forces the
+    serial round-13 worker (the bench A/B baseline and the path engine
+    stubs exercise).  ``complete_hook(dispatch_no, bucket)`` runs at each
+    dispatch's COMPLETION point; an exception it raises (the
+    ``dispatch_fault`` chaos site) is isolated to that one batch —
+    explicit error replies, the worker keeps serving — unlike
+    ``dispatch_hook`` exceptions, which kill the worker (replica death).
     """
 
     _lock_owned = ("_pending", "_pending_images", "_inflight", "_stop",
-                   "_dead", "_busy_s", "_worker", "_t0_wall", "_installs")
+                   "_dead", "_busy_s", "_busy_until", "_worker",
+                   "_t0_wall", "_installs")
 
     def __init__(self, engine, *, svc: Optional[ServiceModel] = None,
                  shed: bool = True, max_queue_images: int = 1024,
                  precision: str = "f32", telemetry=None, replica: int = 0,
-                 dispatch_hook=None, on_death=None):
+                 dispatch_hook=None, complete_hook=None, on_death=None,
+                 pipeline: Optional[bool] = None):
         self.engine = engine
         self.buckets = tuple(engine.buckets)
         self.svc = svc if svc is not None else ServiceModel(self.buckets)
@@ -436,7 +487,15 @@ class SLOScheduler:
         self.telemetry = telemetry if telemetry is not None else NULL
         self.replica = int(replica)
         self.dispatch_hook = dispatch_hook
+        self.complete_hook = complete_hook
         self.on_death = on_death
+        if pipeline is None:
+            pipeline = hasattr(engine, "infer_counts_async")
+        elif pipeline and not hasattr(engine, "infer_counts_async"):
+            raise ValueError(
+                "pipeline=True requires an engine with the async dispatch "
+                "API (infer_counts_async/complete)")
+        self.pipeline = bool(pipeline)
         self._cond = threading.Condition()
         self._pending: List[SchedRequest] = []
         self._pending_images = 0
@@ -444,6 +503,9 @@ class SLOScheduler:
         self._stop = False
         self._dead = False
         self._busy_s = 0.0
+        # Predicted wall time the in-flight pipeline drains (0.0 = idle);
+        # feeds admit(free_at=...) and the overload retry hint.
+        self._busy_until = 0.0
         self._worker: Optional[threading.Thread] = None
         self._t0_wall: Optional[float] = None
         self._dispatches = 0          # worker-thread-local dispatch index
@@ -586,12 +648,17 @@ class SLOScheduler:
 
     def _retry_hint_ms_locked(self, n: int) -> float:
         """Time for the backlog to drain enough to admit ``n`` more images
-        (queue depth x per-max-bucket service-time estimate).  Caller
-        holds ``self._cond``."""
+        (queue depth x per-max-bucket service-time estimate, plus the
+        predicted drain of any in-flight pipeline slots).  Caller holds
+        ``self._cond``."""
         max_b = self.buckets[-1]
         need = self._pending_images + n - self.max_queue_images
         batches = max(1.0, need / float(max_b))
-        return round(1e3 * self.svc.predict(max_b) * batches, 3)
+        hint = 1e3 * self.svc.predict(max_b) * batches
+        inflight_s = self._busy_until - time.time()
+        if inflight_s > 0.0:
+            hint += 1e3 * inflight_s
+        return round(hint, 3)
 
     def outstanding_s(self) -> float:
         """Predicted seconds of queued + in-flight work — the router's
@@ -609,6 +676,9 @@ class SLOScheduler:
 
     def _run(self) -> None:
         try:
+            if self.pipeline:
+                self._run_pipelined()
+                return
             while True:
                 item = self._next_admission()
                 if item is None:
@@ -649,6 +719,179 @@ class SLOScheduler:
             # (an install may device_put / take its time — admission and
             # enqueue must not stall behind it).
             self._run_installs(installs)
+
+    # -- pipelined worker (two in-flight slots) -----------------------------
+
+    def _run_pipelined(self) -> None:
+        """Double-buffered dispatch loop: admit-and-issue into a free slot
+        while the oldest dispatch computes; complete strictly in issue
+        order.  ``inflight`` (worker-local, oldest first) holds at most
+        ``PIPELINE_SLOTS`` issued-but-uncompleted dispatch records."""
+        tel = self.telemetry
+        inflight: List[dict] = []
+        prev_done: Optional[float] = None
+        while True:
+            op, payload = self._next_pipeline_op(len(inflight))
+            if op == "exit":
+                return
+            if op == "installs":
+                # Pipeline fully drained: the engine-free instant between
+                # in-flight pairs — the only point a weight flip may land
+                # (lock released; an install may device_put at leisure).
+                self._run_installs(payload)
+                continue
+            if op == "complete":
+                prev_done = self._complete_oldest(inflight, prev_done)
+            else:  # "admit"
+                adm, now = payload
+                if adm.deferred:
+                    self._note_deferred(adm.deferred, now)
+                if adm.shed:
+                    self._resolve_shed(adm.shed, now)
+                if adm.batch:
+                    inflight.append(self._issue(adm.batch, adm.bucket))
+            if tel.enabled:
+                tel.gauge("serve_inflight", len(inflight),
+                          replica=self.replica)
+
+    def _next_pipeline_op(self, have: int):
+        """Pick the worker's next action under the lock.  Priority: drain
+        toward queued installs; admit-and-issue into a free slot; complete
+        the oldest in-flight dispatch; exit when stopped and drained."""
+        while True:
+            with self._cond:
+                if self._installs:
+                    if have:
+                        return "complete", None
+                    installs = self._installs
+                    self._installs = []
+                    return "installs", installs
+                if self._pending and have < PIPELINE_SLOTS:
+                    now = time.time()
+                    adm = admit(self._pending, now, buckets=self.buckets,
+                                predict_s=self.svc.predict, shed=self.shed,
+                                free_at=self._busy_until if have else None)
+                    taken = {id(r) for r in adm.batch}
+                    taken.update(id(r) for r, _ in adm.shed)
+                    self._pending = [r for r in self._pending
+                                     if id(r) not in taken]
+                    self._pending_images = sum(r.n for r in self._pending)
+                    self._inflight = self._inflight + adm.batch
+                    if adm.batch:
+                        self._busy_until = max(self._busy_until, now) \
+                            + self.svc.predict(adm.bucket)
+                    return "admit", (adm, now)
+                if have:
+                    return "complete", None
+                if self._stop:
+                    return "exit", None
+                self._cond.wait()
+
+    def _issue(self, batch, bucket: int) -> dict:
+        """Issue one admitted batch without fencing it: hook, version tag,
+        assemble, stage into the next arena slot, async dispatch."""
+        t0 = time.time()
+        dno = self._dispatches
+        hook = self.dispatch_hook
+        if hook is not None:
+            hook(dno, bucket)
+        self._dispatches += 1
+        # The version serving THIS batch, read once at issue.  Installs
+        # only land when the pipeline is drained, so no install can flip
+        # weights between this read and the executable consuming them.
+        version = int(getattr(self.engine, "weights_version", -1))
+        images, labels = self._assemble(batch)
+        traces = tuple(r.trace for r in batch)
+        handle = self.engine.infer_counts_async(
+            images, labels, precision=self.precision,
+            trace_ids=traces if self.telemetry.enabled else ())
+        return {"batch": batch, "bucket": bucket, "handle": handle,
+                "t0": t0, "version": version, "dispatch": dno,
+                "traces": traces}
+
+    def _complete_oldest(self, inflight: List[dict],
+                         prev_done: Optional[float]) -> float:
+        """Fence, fetch, account, and reply the OLDEST in-flight dispatch.
+        A ``complete_hook`` exception (the ``dispatch_fault`` chaos site)
+        is isolated to this batch: its requests get explicit error
+        replies, the newer in-flight dispatch is untouched, and the old
+        weights keep serving.  Returns this completion's ``t_ready`` (the
+        next call's ``prev_done``)."""
+        rec = inflight.pop(0)
+        batch, bucket = rec["batch"], rec["bucket"]
+        tel = self.telemetry
+        fault = None
+        chook = self.complete_hook
+        if chook is not None:
+            try:
+                chook(rec["dispatch"], bucket)
+            except Exception as exc:    # isolated: this batch only
+                fault = exc
+        # Fence and fetch even on a fault: the arena slot and the
+        # completion clock must stay consistent (the result is discarded).
+        logits, _, _, t_ready = self.engine.complete(
+            rec["handle"], prev_done=prev_done)
+        t0 = rec["t0"]
+        start = t0 if prev_done is None else max(t0, prev_done)
+        occ_s = max(t_ready - start, 0.0)   # device occupancy, not wall
+        self.svc.observe(bucket, occ_s)
+        svc_ms = round((t_ready - t0) * 1e3, 3)
+        batch_ids = {id(r) for r in batch}
+        with self._cond:
+            self._inflight = tuple(r for r in self._inflight
+                                   if id(r) not in batch_ids)
+            self._busy_s += occ_s
+            self._busy_until = t_ready + sum(
+                self.svc.predict(r2["bucket"]) for r2 in inflight)
+        if tel.enabled:
+            tel.gauge("serve_service_ms", round(occ_s * 1e3, 3),
+                      bucket=bucket, replica=self.replica,
+                      traces=list(rec["traces"]))
+            if fault is not None:
+                tel.counter("serve_dispatch_fault", bucket=bucket,
+                            replica=self.replica,
+                            error=type(fault).__name__)
+        off = 0
+        for r in batch:
+            out = logits[off:off + r.n]
+            off += r.n
+            met = t_ready <= r.deadline
+            qw_ms = round((t0 - r.t_arrival) * 1e3, 3)
+            lat_ms = round((t_ready - r.t_arrival) * 1e3, 3)
+            if tel.enabled:
+                tel.gauge("serve_latency_ms", lat_ms, trace=r.trace,
+                          tier=r.tier, met=met, replica=self.replica)
+                tel.gauge("serve_queue_wait_ms", qw_ms, trace=r.trace,
+                          tier=r.tier, replica=self.replica)
+                if not met and fault is None:
+                    tel.counter("serve_deadline_miss", tier=r.tier,
+                                replica=self.replica)
+                if r.ctx is not None:
+                    tel.span_event("sched_queue", r.t_arrival,
+                                   t0 - r.t_arrival, trace=r.trace,
+                                   tier=r.tier, replica=self.replica,
+                                   bucket=bucket,
+                                   **r.ctx.child("sched").attrs())
+                    if r.t_defer is not None:
+                        tel.span_event("sched_defer", r.t_defer,
+                                       t0 - r.t_defer, trace=r.trace,
+                                       **r.ctx.child("sched").attrs())
+            if r.future is not None and not r.future.done():
+                if fault is not None:
+                    r.future.set_result(Reply(
+                        status="error", trace=r.trace, tier=r.tier,
+                        reason=f"{type(fault).__name__}: {fault}",
+                        queue_wait_ms=qw_ms, service_ms=svc_ms,
+                        latency_ms=lat_ms, replica=self.replica,
+                        model_version=rec["version"]))
+                else:
+                    r.future.set_result(Reply(
+                        status="ok" if met else "late", trace=r.trace,
+                        tier=r.tier, logits=out, queue_wait_ms=qw_ms,
+                        service_ms=svc_ms, latency_ms=lat_ms,
+                        replica=self.replica,
+                        model_version=rec["version"]))
+        return t_ready
 
     def _note_deferred(self, deferred, now: float) -> None:
         """Stamp first-deferral time on requests miss-repair pushed back
@@ -691,8 +934,9 @@ class SLOScheduler:
         # (``slow_replica`` — a straggling chip) is service time the
         # router's EWMA must learn, not queue wait.
         t0 = time.time()
+        dno = self._dispatches
         if hook is not None:
-            hook(self._dispatches, bucket)
+            hook(dno, bucket)
         self._dispatches += 1
         # The version serving THIS batch, read once at dispatch.  Installs
         # only land at loop boundaries (never mid-dispatch), so the value
@@ -709,6 +953,17 @@ class SLOScheduler:
         else:
             logits, _, _ = self.engine.infer_counts(
                 images, labels, precision=self.precision)
+        # Completion point: the serial twin of the pipelined worker's
+        # complete-side hook, so the dispatch_fault chaos site fires (and
+        # pins bitwise) identically in both modes.  A hook exception is
+        # isolated to this batch — explicit error replies, worker lives.
+        fault = None
+        chook = self.complete_hook
+        if chook is not None:
+            try:
+                chook(dno, bucket)
+            except Exception as exc:
+                fault = exc
         t_done = time.time()
         svc_s = t_done - t0
         self.svc.observe(bucket, svc_s)
@@ -718,6 +973,10 @@ class SLOScheduler:
         if tel.enabled:
             tel.gauge("serve_service_ms", round(svc_s * 1e3, 3),
                       bucket=bucket, replica=self.replica, traces=list(traces))
+            if fault is not None:
+                tel.counter("serve_dispatch_fault", bucket=bucket,
+                            replica=self.replica,
+                            error=type(fault).__name__)
         off = 0
         for r in batch:
             out = logits[off:off + r.n]
@@ -730,7 +989,7 @@ class SLOScheduler:
                           tier=r.tier, met=met, replica=self.replica)
                 tel.gauge("serve_queue_wait_ms", qw_ms, trace=r.trace,
                           tier=r.tier, replica=self.replica)
-                if not met:
+                if not met and fault is None:
                     tel.counter("serve_deadline_miss", tier=r.tier,
                                 replica=self.replica)
                 if r.ctx is not None:
@@ -748,11 +1007,20 @@ class SLOScheduler:
                                        t0 - r.t_defer, trace=r.trace,
                                        **r.ctx.child("sched").attrs())
             if r.future is not None and not r.future.done():
-                r.future.set_result(Reply(
-                    status="ok" if met else "late", trace=r.trace,
-                    tier=r.tier, logits=out, queue_wait_ms=qw_ms,
-                    service_ms=round(svc_s * 1e3, 3), latency_ms=lat_ms,
-                    replica=self.replica, model_version=version))
+                if fault is not None:
+                    r.future.set_result(Reply(
+                        status="error", trace=r.trace, tier=r.tier,
+                        reason=f"{type(fault).__name__}: {fault}",
+                        queue_wait_ms=qw_ms,
+                        service_ms=round(svc_s * 1e3, 3),
+                        latency_ms=lat_ms, replica=self.replica,
+                        model_version=version))
+                else:
+                    r.future.set_result(Reply(
+                        status="ok" if met else "late", trace=r.trace,
+                        tier=r.tier, logits=out, queue_wait_ms=qw_ms,
+                        service_ms=round(svc_s * 1e3, 3), latency_ms=lat_ms,
+                        replica=self.replica, model_version=version))
 
     def _die(self, exc: Exception) -> None:
         with self._cond:

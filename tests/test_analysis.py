@@ -1468,3 +1468,89 @@ def test_repo_static_verification(zoo):
     assert zoo.clean, "\n".join(zoo.format_lines())
     cert = dispatchlib.certify_zoo(zoo, window=3, nbatches=25)
     assert cert["clean"], json.dumps(cert["findings"], indent=2)
+
+
+# ---------------------------------------------------------------------------
+# Round 14: fused-ingest edge rule, async-dispatch lint, serving-scan cert
+# ---------------------------------------------------------------------------
+
+_U8_RUNG = """\
+HloModule rung
+
+ENTRY main {
+  img = u8[8,32,32,3] parameter(0)
+  w = f32[3072,10] parameter(1)
+  f = f32[8,32,32,3] convert(img)
+  r = f32[8,3072] reshape(f)
+  ROOT d = f32[8,10] dot(r, w), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+
+
+def test_rule_ingest_edge_seeded():
+    # A fused rung: u8 image at the edge, in-program convert -> clean.
+    r = auditlib.audit_program(_U8_RUNG, _contract(u8_edge=True))
+    assert r.passed, r.findings
+    # Float image-shaped entry parameter: the normalize left the program
+    # and the wire pays 4x.
+    leaked = _U8_RUNG.replace("img = u8[8,32,32,3] parameter(0)",
+                              "img = f32[8,32,32,3] parameter(0)") \
+                     .replace("f = f32[8,32,32,3] convert(img)",
+                              "f = f32[8,32,32,3] negate(img)")
+    r = auditlib.audit_program(leaked, _contract(u8_edge=True))
+    assert _rules_of(r) == {"ingest-edge"}
+    assert "4x transfer" in r.findings[0].message
+    # u8 image parameter but no in-program float convert: the program
+    # never normalizes on device.
+    raw = _U8_RUNG.replace("f = f32[8,32,32,3] convert(img)",
+                           "f = f32[8,32,32,3] iota(), iota_dimension=0")
+    r = auditlib.audit_program(raw, _contract(u8_edge=True))
+    assert _rules_of(r) == {"ingest-edge"}
+    assert "never normalizes" in r.findings[0].message
+    # The rule is contract-gated: without u8_edge the same float-edge
+    # program is a legitimate training lowering.
+    assert auditlib.audit_program(leaked, _contract()).passed
+
+
+_SRC_ASYNC_UNFENCED = """\
+import time
+
+class T:
+    def run(self, x):
+        t0 = time.time()
+        h = self.infer_counts_async(x)
+        return time.time() - t0
+"""
+
+
+def test_lint_unfenced_timing_async_dispatch():
+    # issue-without-complete inside a timing window: the timer stops
+    # before the device ran anything.
+    bad = pylint_rules.lint_source(_SRC_ASYNC_UNFENCED, "bad.py")
+    assert [f.rule for f in bad] == ["unfenced-timing"]
+    # complete() IS the fence for the async path.
+    fenced = _SRC_ASYNC_UNFENCED.replace(
+        "h = self.infer_counts_async(x)",
+        "h = self.infer_counts_async(x)\n        out = self.complete(h)")
+    assert pylint_rules.lint_source(fenced, "ok.py") == []
+
+
+def test_cert_serving_rung_straight_line():
+    # The static half of the two-in-flight bound: a serving rung that
+    # lowers to a scan would host-sync inside the program.
+    cert = dispatchlib.ProgramCert(program="serve/b8/f32", path="serve",
+                                   scan_trips=(3,), donated=0)
+    rules = [f.rule for f in dispatchlib.check_cert(cert)]
+    assert rules == ["dispatch-serving-scan"]
+    clean = dispatchlib.ProgramCert(program="serve/b8/f32", path="serve",
+                                    scan_trips=(), donated=0)
+    assert dispatchlib.check_cert(clean) == []
+    # Static bound == scheduler constant == arena depth.
+    from cs744_ddp_tpu.serve import PIPELINE_SLOTS
+    assert dispatchlib.serving_inflight_bound() == PIPELINE_SLOTS == 2
+    # Runtime half: occupancy scan over telemetry gauge records.
+    recs = [{"kind": "gauge", "name": "serve_inflight", "value": v}
+            for v in (1, 2, 1, 0)]
+    recs.append({"kind": "gauge", "name": "other", "value": 9})
+    assert dispatchlib.max_serving_inflight(recs) == 2
+    assert dispatchlib.max_serving_inflight([]) == 0

@@ -411,6 +411,19 @@ def test_emit_head_budget_with_committed_serving_load(tmp_path):
     assert two["complete"] > 0
     assert any(p["skew_pairs"] > 0 for p in two["skew"].values())
     assert two["aggregate_wall_s"] < 10.0
+    # Round 14: the dispatch-pipeline section honors ITS contracts —
+    # pipelined capacity beats the committed round-9 figure, runtime
+    # occupancy stays within the static two-slot bound, and the
+    # bucket-8 dispatch tax shrank from the round-12 figure.
+    pipe = result["pipeline"]
+    assert pipe["capacity"]["beats_round9"] is True
+    assert pipe["capacity"]["capacity_rps_on"] \
+        > pipe["capacity"]["round9_capacity_rps"] == 441.6
+    wf = pipe["waterfall"]
+    assert wf["inflight_bound_ok"] is True
+    assert wf["max_inflight"] <= 2
+    b8 = wf["cost_prior"]["by_bucket"]["8"]["measured_over_prior"]
+    assert b8 < 3.254          # the round-12 dispatch-tax figure
     lines = []
     head = bench.emit_result(result, str(tmp_path / "FULL.json"),
                              out=lines.append)
@@ -421,6 +434,7 @@ def test_emit_head_budget_with_committed_serving_load(tmp_path):
     assert "serving_load" not in parsed
     assert "hotswap" not in parsed
     assert "tracing" not in parsed
+    assert "pipeline" not in parsed
     assert json.loads((tmp_path / "FULL.json").read_text()) == result
 
 

@@ -895,3 +895,61 @@ def test_swap_mid_batch_probe_never_mixes_weights(tmp_path):
     assert (r0.model_version, r1.model_version, r2.model_version) == (1, 1, 2)
     np.testing.assert_array_equal(r1.logits, r0.logits)   # old model, whole batch
     assert not np.array_equal(r2.logits, r1.logits)       # new model after flip
+
+
+# -- round 14: completion-side chaos (dispatch_fault) -------------------------
+
+
+def test_chaos_dispatch_fault_site_registered_one_shot():
+    from cs744_ddp_tpu.ft.chaos import REPLICA_SITES
+    assert "dispatch_fault" in SITES
+    assert "dispatch_fault" in REPLICA_SITES
+    plan = ChaosPlan.parse(["dispatch_fault:1:0"])
+    assert plan.seed_of("dispatch_fault", 1) == 0   # third field = replica
+    assert plan.fire("dispatch_fault", 1)
+    assert not plan.fire("dispatch_fault", 1)       # one-shot
+    assert plan.fired == [("dispatch_fault", 1)]
+
+
+def test_dispatch_fault_isolated_bitwise_recovery_pipelined_vs_serial():
+    """dispatch_fault recovery pin: the chaos site discards dispatch 1's
+    device result at its completion fence (with the pipelined worker,
+    while dispatch 2 is already in flight).  Both workers isolate the
+    fault — dispatch 1's request gets an explicit error reply, every
+    neighbour resolves ok on the SAME weights, the worker survives —
+    and the non-faulted replies are bitwise-identical between the
+    pipelined and serial paths."""
+    from cs744_ddp_tpu import models as model_zoo
+    from cs744_ddp_tpu.serve import EngineReplica
+    model_zoo.register_model("tiny", tiny_cnn)
+    pool = cifar10._synthetic_split(16, seed=5)
+
+    def _serve(pipeline):
+        plan = ChaosPlan.parse(["dispatch_fault:1:0"])
+        rep = EngineReplica(0, model="tiny", buckets=(2, 4), seed=0,
+                            chaos=plan, pipeline=pipeline)
+        # Full-max-bucket requests submitted before the worker starts:
+        # each dispatch carries exactly one request, so the faulted
+        # dispatch number maps deterministically to one reply.
+        futs = [rep.scheduler.submit(pool.images[4 * i:4 * i + 4],
+                                     slo_ms=None)
+                for i in range(4)]
+        rep.start()
+        try:
+            replies = [f.result(30.0) for f in futs]
+        finally:
+            rep.stop()
+        return plan, replies
+
+    plan_p, piped = _serve(True)
+    plan_s, serial = _serve(False)
+    for plan, replies in ((plan_p, piped), (plan_s, serial)):
+        assert [r.status for r in replies] == ["ok", "error", "ok", "ok"]
+        assert plan.fired == [("dispatch_fault", 1)]    # fired exactly once
+        assert "ChaosError" in replies[1].reason
+        assert replies[1].logits is None
+        # Old weights keep serving around the fault: one version tag.
+        assert {r.model_version for r in replies} == {0}
+    for a, b in zip(serial, piped):
+        if a.status == "ok":
+            np.testing.assert_array_equal(a.logits, b.logits)

@@ -310,6 +310,8 @@ _BAKED = """\
 HloModule {name}
 
 ENTRY main {{
+  img = u8[2,32,32,3]{{3,2,1,0}} parameter(1)
+  x = f32[2,32,32,3]{{3,2,1,0}} convert(img)
   c = f32[{n}]{{0}} constant({{...}})
   p = f32[{n}]{{0}} parameter(0)
   ROOT o = f32[{n}]{{0}} add(c, p)
@@ -403,3 +405,57 @@ def test_telemetry_report_publish_section(tmp_path, monkeypatch):
     tel2.step(epoch=0, iter=0, loss=1.0, step_time=0.01)
     tel2.finalize()
     assert "== publish" not in telemetry_report.render(str(plain))
+
+
+def test_hot_swap_lands_at_pipeline_drain_between_pairs(tmp_path, pool):
+    """Round 14: with the pipelined worker, a weight flip queued while
+    TWO dispatches are in flight lands only at the drain point between
+    in-flight pairs — both outstanding batches answer bitwise on the old
+    weights, the next dispatch on the new, zero recompiles (the A/B pin
+    across a pipelined pair)."""
+    import time as _t
+
+    from cs744_ddp_tpu.ft import ChaosPlan
+
+    pub_dir = str(tmp_path / "pub")
+    pub = WeightPublisher(pub_dir, fingerprint={"model": "tiny"})
+    pub.publish(_state(1))                            # v1
+    # slow_replica stalls dispatch 1's ISSUE hook: while it sleeps,
+    # dispatch 0 is already in flight, giving the main thread a window
+    # to queue the v2 flip with both pipeline slots claimed.
+    plan = ChaosPlan.parse(["slow_replica:1:0"])
+    rep = EngineReplica(0, model="tiny", buckets=(2, 4), seed=0,
+                        chaos=plan, slow_stall_s=1.0, pipeline=True)
+    watcher = WeightWatcher(pub_dir, [rep])
+    assert watcher.poll_once() == "installed"         # v1 before serving
+
+    # Full-max-bucket requests: one per dispatch, deterministic numbering.
+    futs = [rep.scheduler.submit(pool.images[4 * i:4 * i + 4], slo_ms=None)
+            for i in range(3)]
+    pub.publish(_state(2))                            # v2 on disk, unseen
+    rep.start()
+    try:
+        deadline = _t.time() + 10.0
+        while ("slow_replica", 1) not in plan.fired:
+            assert _t.time() < deadline, "chaos stall never fired"
+            _t.sleep(0.01)
+        watcher.poll_once(wait=False)   # queue the flip mid-stall
+        replies = [f.result(30.0) for f in futs]
+    finally:
+        rep.stop()
+
+    # The in-flight pair answered on v1, the post-drain dispatch on v2.
+    assert [r.status for r in replies] == ["ok"] * 3
+    assert [r.model_version for r in replies] == [1, 1, 2]
+    assert rep.engine.weights_version == 2
+    # One bucket served three dispatches across the flip on ONE compiled
+    # executable: the install swapped weights, never the program.
+    assert set(rep.engine._exec) == {(4, "f32")}
+    # The bitwise half, against reference engines fed each bundle
+    # through the same install entry point.
+    ref = InferenceEngine("tiny", buckets=(2, 4), seed=0)
+    for v, r in zip((1, 1, 2), replies):
+        _install_version(ref, pub_dir, v)
+        imgs = pool.images[4 * replies.index(r):4 * replies.index(r) + 4]
+        want, _, _ = ref.infer_counts(imgs)
+        np.testing.assert_array_equal(r.logits, np.asarray(want))

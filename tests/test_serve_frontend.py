@@ -454,3 +454,151 @@ def test_make_request_validation():
         make_request(_imgs(2), labels=np.zeros(3, np.int32))
     req = make_request(_imgs(2), slo_ms=None)
     assert req.deadline == float("inf") and isinstance(req.future, Future)
+
+
+# -- dispatch pipeline (round 14) ---------------------------------------------
+
+
+def test_admit_free_at_two_slot_semantics():
+    """``admit(free_at=)`` — pipelined second-slot admission: predicted
+    completions are measured from when the engine actually frees a slot,
+    not the admission instant; ``None`` / a past ``free_at`` (idle
+    pipeline) is the round-13 policy bit-for-bit; already-late shed is
+    still judged against NOW."""
+    svc = ServiceModel((2, 4), anchor_s=0.010)
+    now = 1000.0
+    r = _vreq(2, 0, now + 0.035, seq=0)
+    base = admit([r], now, buckets=(2, 4), predict_s=svc.predict)
+    idle = admit([r], now, buckets=(2, 4), predict_s=svc.predict,
+                 free_at=now - 5.0)
+    assert idle == base
+    assert base.batch == (r,)
+    assert base.predicted_done == pytest.approx(now + 0.010)
+    # Second slot: the engine frees at now+20ms, so this batch completes
+    # at now+30ms — still inside its deadline, admitted.
+    busy = admit([r], now, buckets=(2, 4), predict_s=svc.predict,
+                 free_at=now + 0.020)
+    assert busy.batch == (r,)
+    assert busy.predicted_done == pytest.approx(now + 0.030)
+    # A deadline the idle slot makes but the busy slot cannot is a
+    # predicted miss (nothing lower-priority to defer -> shed).
+    tight = _vreq(2, 0, now + 0.012, seq=1)
+    assert admit([tight], now, buckets=(2, 4),
+                 predict_s=svc.predict).batch == (tight,)
+    a = admit([tight], now, buckets=(2, 4), predict_s=svc.predict,
+              free_at=now + 0.020)
+    assert a.batch == ()
+    assert [(req.seq, reason) for req, reason in a.shed] \
+        == [(1, "predicted_miss")]
+    # Already-late: shed as "deadline" vs NOW, free_at irrelevant.
+    late = _vreq(2, 0, now - 1.0, seq=2)
+    a2 = admit([late], now, buckets=(2, 4), predict_s=svc.predict,
+               free_at=now + 0.020)
+    assert [(req.seq, reason) for req, reason in a2.shed] \
+        == [(2, "deadline")]
+
+
+def test_scheduler_rejects_pipeline_without_async_engine():
+    with pytest.raises(ValueError, match="infer_counts_async"):
+        SLOScheduler(StubEngine(), pipeline=True)
+    # Auto-detection: a bare infer_counts engine falls back to serial.
+    assert SLOScheduler(StubEngine()).pipeline is False
+
+
+def test_pipelined_bitwise_vs_serial_seeded_trace(pool):
+    """Tentpole pin: the pipelined worker answers a mixed-bucket trace
+    (ragged tail included) bitwise-identically to the serial round-13
+    worker.  Batch composition may differ between the two runs — rows
+    are batchmate-invariant (train=False BN, pinned in test_serve.py) —
+    so the per-request logits must still match exactly."""
+    sizes = [1, 3, 2, 4, 8, 5, 2, 1, 7, 3, 4, 6]
+
+    def _serve(pipeline):
+        rep = EngineReplica(0, model="tiny", buckets=(2, 4, 8), seed=0,
+                            pipeline=pipeline)
+        assert rep.scheduler.pipeline is pipeline
+        futs, off = [], 0
+        for n in sizes:
+            futs.append(rep.scheduler.submit(pool.images[off:off + n],
+                                             slo_ms=None))
+            off += n
+        with rep.scheduler:
+            return [f.result(60.0) for f in futs]
+
+    serial = _serve(False)
+    piped = _serve(True)
+    assert [r.status for r in serial] == ["ok"] * len(sizes)
+    assert [r.status for r in piped] == ["ok"] * len(sizes)
+    for a, b in zip(serial, piped):   # futures in submit order
+        np.testing.assert_array_equal(a.logits, b.logits)
+    # The accounting invariant survives the overlap: latency decomposes
+    # into queue wait + service, with service the fence-to-fence window
+    # of the request's own dispatch (not the overlapped wall clock).
+    for r in piped:
+        assert r.latency_ms == pytest.approx(
+            r.queue_wait_ms + r.service_ms, abs=1.0)
+
+
+def test_pipelined_occupancy_bound_and_span_causality(pool):
+    """Runtime two-slot occupancy meets the static bound exactly, and
+    the engine's async spans stay causally attributable: each
+    ``serve_dispatch``/``serve_fetch`` span names exactly its batch's
+    trace ids, and the dispatch spans are occupancy-honest — clipped to
+    issue order, never overlapping."""
+    from cs744_ddp_tpu.analysis import dispatch as dispatchlib
+    from cs744_ddp_tpu.obs import Telemetry
+
+    tel = Telemetry()           # in-memory recorder
+    rep = EngineReplica(0, model="tiny", buckets=(2, 4), seed=0,
+                        telemetry=tel, pipeline=True)
+    # Full-max-bucket requests, submitted before the worker starts: each
+    # dispatch carries exactly one request, and the queue holds several
+    # dispatches at start so the second slot MUST fill.
+    futs = [rep.scheduler.submit(pool.images[4 * i:4 * i + 4], slo_ms=None)
+            for i in range(5)]
+    with rep.scheduler:
+        replies = [f.result(60.0) for f in futs]
+    assert [r.status for r in replies] == ["ok"] * 5
+    events = tel.records
+    bound = dispatchlib.serving_inflight_bound()
+    assert bound == 2
+    assert dispatchlib.max_serving_inflight(events) == bound
+    dspans = [e for e in events if e.get("kind") == "span"
+              and e["name"] == "serve_dispatch"]
+    fspans = [e for e in events if e.get("kind") == "span"
+              and e["name"] == "serve_fetch"]
+    assert len(dspans) == len(fspans) == 5
+    want = [[r.trace] for r in replies]
+    assert [d["traces"] for d in dspans] == want
+    assert [f["traces"] for f in fspans] == want
+    for prev, nxt in zip(dspans, dspans[1:]):
+        assert nxt["t"] >= prev["t"] + prev["dur_s"] - 1e-6
+
+
+def test_telemetry_report_pipeline_section(tmp_path, monkeypatch):
+    """The pipelined worker's occupancy gauges and fault counter render
+    as ``== dispatch pipeline ==``; a serial run renders without it —
+    absent-safe for older runs."""
+    import os
+    from cs744_ddp_tpu.obs import Telemetry
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.syspath_prepend(os.path.join(repo, "tools"))
+    import telemetry_report
+
+    run = tmp_path / "piped"
+    tel = Telemetry(out_dir=str(run))
+    for v in (1, 2, 2, 1, 0):
+        tel.gauge("serve_inflight", v, replica=0)
+    tel.counter("serve_dispatch_fault", bucket=4, replica=0,
+                error="ChaosError")
+    tel.finalize()
+    text = telemetry_report.render(str(run))
+    assert "== dispatch pipeline ==" in text
+    assert "replica 0" in text and "max 2" in text
+    assert "dispatch faults        1" in text
+
+    plain = tmp_path / "plain"
+    tel2 = Telemetry(out_dir=str(plain))
+    tel2.step(epoch=0, iter=0, loss=1.0, step_time=0.01)
+    tel2.finalize()
+    assert "== dispatch pipeline" not in telemetry_report.render(str(plain))

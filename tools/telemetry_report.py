@@ -336,6 +336,42 @@ def _publish_lines(events) -> list:
     return lines
 
 
+def _pipeline_lines(events) -> list:
+    """Dispatch-pipeline rendering (round 14): the scheduler's
+    ``serve_inflight`` gauge traces per-replica pipeline occupancy (0..
+    ``PIPELINE_SLOTS``) after every issue/completion — the occupancy
+    distribution says how often batch N+1 actually overlapped batch N.
+    ``serve_dispatch_fault`` counts completion-side faults that were
+    isolated to one batch (explicit error replies, worker survived).
+    Returns [] for runs with no pipeline signal — serial-mode and older
+    runs render unchanged."""
+    occ = {}
+    faults = 0
+    for e in events:
+        kind, name = e.get("kind"), e.get("name")
+        if kind == "gauge" and name == "serve_inflight":
+            per = occ.setdefault(e.get("replica", "?"), {})
+            v = int(e.get("value", 0))
+            per[v] = per.get(v, 0) + 1
+        elif kind == "counter" and name == "serve_dispatch_fault":
+            faults += int(e.get("inc", 1))
+    if not occ and not faults:
+        return []
+    lines = ["== dispatch pipeline =="]
+    for replica in sorted(occ, key=str):
+        per = occ[replica]
+        n = sum(per.values())
+        detail = "  ".join(f"{d} slots {per[d] / n:.0%}"
+                           for d in sorted(per))
+        lines.append(f"  replica {replica!s:<4} occupancy x{n:<6} "
+                     f"max {max(per)}  {detail}")
+    if faults:
+        lines.append(f"  dispatch faults        {faults} "
+                     f"(isolated: error replies, worker survived)")
+    lines.append("")
+    return lines
+
+
 def _waterfall_lines(out_dir: str, events) -> list:
     """Distributed-trace rendering (round 12, ``obs/aggregate.py``): when
     the run carries ``trace_id``-stamped spans, reconstruct this one
@@ -460,6 +496,7 @@ def render(out_dir: str) -> str:
     lines.extend(_trace_lines(events))
     lines.extend(_slo_lines(events))
     lines.extend(_publish_lines(events))
+    lines.extend(_pipeline_lines(events))
     lines.extend(_waterfall_lines(out_dir, events))
     lines.extend(_alert_lines(events))
 
