@@ -1931,6 +1931,122 @@ def run_attribution(log, *, headline_model: str = "vgg11",
     return out
 
 
+def run_memory(log, *, headline_model: str = "vgg11",
+               global_batch: int = 256, zoo=None,
+               planner_worlds=(1, 2, 8),
+               planner_window: int = 4) -> Optional[dict]:
+    """Memory certification (round 20): the static liveness certifier
+    (``analysis/memlife.py``) over every zoo lowering — peak HBM
+    residency per program vs the single-sourced v5e capacity — plus a
+    compiled differential on the headline train window (static peak must
+    clear XLA's ``memory_analysis()`` temp+output floor and stay within
+    the declared band), the process's live-array gauge as a runtime
+    cross-check, and the K-epoch feasibility table
+    (``analysis/megaplan.max_feasible_K``) at 16 GiB for the mega-program
+    ROADMAP item.  None (logged reason) when certification fails —
+    advisory, never fatal."""
+    import jax
+
+    from cs744_ddp_tpu.analysis import (audit as auditlib, costmodel,
+                                        megaplan, memlife)
+
+    log = log or (lambda s: print(s, file=sys.stderr))
+    res = zoo
+    if res is None or not res.hlo:
+        res = _zoo_result(log, headline_model=headline_model,
+                          global_batch=global_batch, collect_hlo=True)
+    if res is None:
+        return None
+    try:
+        reports = {name: memlife.mem_report(text, name)
+                   for name, text in res.hlo.items()}
+    except Exception as e:   # noqa: BLE001 - advisory section
+        log(f"[bench] memory: liveness sweep failed ({e!r}); "
+            "section omitted")
+        return None
+    budget = costmodel.V5E_HBM_CAPACITY_BYTES
+    fattest = max(reports.values(), key=lambda r: r.peak_bytes)
+    log(f"[bench] memory: {len(reports)} programs certified; fattest "
+        f"{fattest.name} at {fattest.peak_bytes / 2**20:.1f} MiB of "
+        f"{budget / 2**20:.0f} MiB")
+    out = {
+        "protocol": "static buffer-liveness peak per zoo lowering "
+                    "(analysis/memlife.py) vs the single-sourced v5e HBM "
+                    "capacity; compiled differential on the headline "
+                    "window; K-epoch planner (analysis/megaplan.py)",
+        "budget_mib": round(budget / 2**20, 1),
+        "peak_mib_by_program": {
+            name: round(r.peak_bytes / 2**20, 3)
+            for name, r in sorted(reports.items())},
+        "max_peak": {
+            "program": fattest.name,
+            "peak_mib": round(fattest.peak_bytes / 2**20, 3),
+            "headroom_mib": round(
+                (budget - fattest.peak_bytes) / 2**20, 3),
+        },
+    }
+
+    # Compiled differential: the same window the attribution section
+    # measures, compiled here so the artifact records the static bound
+    # sitting on the right side of XLA's own accounting.
+    try:
+        ndev = len(jax.devices())
+        lowered, name = megaplan.lower_window(
+            headline_model, world=ndev, global_batch=global_batch,
+            strategy="ddp" if ndev > 1 else "single")
+        rep = memlife.mem_report(auditlib._hlo_text(lowered), name)
+        ms = lowered.compile().memory_analysis()
+        bad = memlife.check_against_compiled(rep, ms, windowed=True)
+        floor = ((getattr(ms, "temp_size_in_bytes", 0) or 0)
+                 + (getattr(ms, "output_size_in_bytes", 0) or 0))
+        out["compiled_check"] = {
+            "program": name,
+            "static_peak_mib": round(rep.peak_bytes / 2**20, 3),
+            "compiled_floor_mib": round(floor / 2**20, 3),
+            "band": memlife.COMPILED_BAND,
+            "clean": not bad,
+            "findings": bad,
+        }
+        log(f"[bench] memory: compiled check on {name} "
+            f"{'clean' if not bad else 'FAILED'} (static "
+            f"{rep.peak_bytes / 2**20:.1f} MiB vs floor "
+            f"{floor / 2**20:.1f} MiB)")
+    except Exception as e:   # noqa: BLE001 - advisory section
+        log(f"[bench] memory: compiled differential failed ({e!r}); "
+            "static sweep kept")
+
+    # Runtime cross-check: what this process actually holds live on
+    # device right now (the per-run gauge lives in telemetry; tier-1
+    # pins gauge <= certificate on a real windowed run).
+    try:
+        live = jax.live_arrays()
+        out["runtime_live_mib"] = round(
+            sum(int(getattr(a, "nbytes", 0) or 0) for a in live) / 2**20,
+            2)
+        out["runtime_live_arrays"] = len(live)
+    except Exception:   # noqa: BLE001 - backend without the API
+        pass
+
+    # K-epoch mega-program feasibility (ROADMAP item 3 entry criterion).
+    plans = {}
+    for w in planner_worlds:
+        try:
+            plan = megaplan.plan_feasibility(
+                headline_model, w, planner_window,
+                global_batch=global_batch)
+            plans[str(w)] = plan.to_dict()
+            log(f"[bench] memory: planner {headline_model} world {w} "
+                f"window {planner_window} -> max_k {plan.max_k} "
+                f"(saves {plan.round_trips_saved} round-trips)")
+        except Exception as e:   # noqa: BLE001 - advisory section
+            log(f"[bench] memory: planner world {w} failed ({e!r})")
+    if plans:
+        out["planner"] = {"model": headline_model,
+                          "window": planner_window,
+                          "per_world": plans}
+    return out
+
+
 def run_bench(*, matrix: bool = True, sweep: bool = True,
               peak: bool = True, convergence: bool = True,
               convergence_epochs: int = 3,
@@ -1944,6 +2060,7 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
               elastic: bool = True,
               audit: bool = True,
               attribution: bool = True,
+              memory: bool = True,
               serving_kwargs=None,
               max_iters: int = 100,
               global_batch: int = 256,
@@ -2299,13 +2416,13 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
             global_batch=global_batch, data_dir=data_dir,
             max_iters=max_iters)
 
-    # Static program audit + cost-model attribution: ONE set of zoo
-    # lowerings feeds both sections — the certification and the cost
-    # numbers cannot drift apart.
-    if audit or attribution:
+    # Static program audit + cost-model attribution + memory
+    # certification: ONE set of zoo lowerings feeds all three sections —
+    # the certification and the numbers cannot drift apart.
+    if audit or attribution or memory:
         zoo = _zoo_result(log, headline_model=headline_model,
                           global_batch=global_batch,
-                          collect_hlo=attribution)
+                          collect_hlo=attribution or memory)
         if audit:
             audit_summary = run_audit(log, headline_model=headline_model,
                                       global_batch=global_batch, zoo=zoo)
@@ -2319,6 +2436,11 @@ def run_bench(*, matrix: bool = True, sweep: bool = True,
                 max_iters=max_iters, zoo=zoo)
             if attr is not None:
                 result["attribution"] = attr
+        if memory:
+            mem = run_memory(log, headline_model=headline_model,
+                             global_batch=global_batch, zoo=zoo)
+            if mem is not None:
+                result["memory"] = mem
 
     if sweep:
         # WEAK scaling: per-chip batch held at ``global_batch`` while the
@@ -2510,6 +2632,11 @@ def main(argv=None) -> None:
                         "(analysis/costmodel.py analytic FLOPs/bytes per "
                         "zoo program + the measured MFU join on the "
                         "headline windowed program)")
+    p.add_argument("--no-memory", action="store_true",
+                   help="skip the memory certification section "
+                        "(analysis/memlife.py peak-HBM liveness per zoo "
+                        "program, the compiled differential, and the "
+                        "analysis/megaplan.py K-epoch feasibility table)")
     p.add_argument("--max-iters", type=int, default=100,
                    help="minimum steady-state iterations per config")
     p.add_argument("--global-batch", type=int, default=256)
@@ -2558,6 +2685,7 @@ def main(argv=None) -> None:
                        audit=not (args.no_audit or args.no_matrix),
                        attribution=not (args.no_attribution
                                         or args.no_matrix),
+                       memory=not (args.no_memory or args.no_matrix),
                        max_iters=args.max_iters,
                        global_batch=args.global_batch)
     emit_result(result, args.full_out or os.path.join(

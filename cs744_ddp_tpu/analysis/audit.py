@@ -38,6 +38,15 @@ tests/test_analysis.py):
 - ``donation`` — programs declared to donate the train state must
   donate >= n_state_leaves entry buffers (``buffer_donor`` /
   ``input_output_alias`` module header); a miss doubles peak HBM.
+  Donation is additionally proven as an ALIASED-BYTES equality
+  (:func:`memlife.donation_alias_findings`): every donated entry
+  buffer must have a same-size output leaf to alias, or XLA quietly
+  copies and the in-place update is fiction.
+- ``peak-memory`` — the static buffer-liveness bound
+  (:func:`memlife.mem_report`) must fit the contract's
+  ``hbm_budget_bytes`` (default: the single-sourced v5e chip capacity,
+  :data:`costmodel.V5E_HBM_CAPACITY_BYTES`).  The fattest live set is
+  named in the finding, so an over-budget program says WHAT to shrink.
 - ``host-sync`` — no infeed/outfeed/send/recv or host-callback
   custom-calls inside ``while`` bodies (HLO side), and no callback
   primitives inside ``scan``/``while`` sub-jaxprs (jaxpr side): a host
@@ -66,7 +75,7 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from . import hlo_ir, stats
+from . import costmodel, hlo_ir, memlife, stats
 
 DEFAULT_MAX_CONSTANT_BYTES = 1 << 20     # 1 MiB: far above any mask/iota
                                          # table, far below weights/data
@@ -105,6 +114,8 @@ class ProgramContract:
     u8_edge: bool = False                # fused-ingest contract: uint8
                                          # images at the program edge,
                                          # normalize in-program
+    hbm_budget_bytes: int = 0            # static peak-HBM budget; 0 =
+                                         # the v5e chip capacity
 
 
 @dataclass
@@ -286,17 +297,39 @@ def _rule_dtype_leak(module: hlo_ir.Module, jaxpr,
 
 def _rule_donation(module: hlo_ir.Module, jaxpr,
                    c: ProgramContract) -> List[Finding]:
+    out: List[Finding] = []
+    # Aliased-bytes round-trip: whatever IS donated must be provably
+    # aliasable, declared or not.
+    for msg in memlife.donation_alias_findings(module, c.name):
+        out.append(Finding("donation", c.name, msg))
     if not c.donates_state:
-        return []
+        return out
     n = module.donated_param_count()
     if n < c.n_state_leaves:
-        return [Finding(
+        out.append(Finding(
             "donation", c.name,
             f"declared to donate the train state but only {n} of >= "
             f"{c.n_state_leaves} entry buffers are donated "
             f"(buffer_donor/input_output_alias) — un-donated state "
-            f"doubles peak HBM")]
-    return []
+            f"doubles peak HBM"))
+    return out
+
+
+def _rule_peak_memory(module: hlo_ir.Module, jaxpr,
+                      c: ProgramContract) -> List[Finding]:
+    budget = c.hbm_budget_bytes or costmodel.V5E_HBM_CAPACITY_BYTES
+    rep = memlife.mem_report(module, c.name)
+    if rep.peak_bytes <= budget:
+        return []
+    top = rep.top_sets[0] if rep.top_sets else {}
+    fattest = ", ".join(
+        f"{n}={b}" for n, b in top.get("members", [])[:4])
+    return [Finding(
+        "peak-memory", c.name,
+        f"static peak HBM {rep.peak_bytes} B "
+        f"({rep.peak_bytes / 2**20:.1f} MiB) exceeds the "
+        f"{budget} B budget; fattest live set at "
+        f"{top.get('instruction', '?')!r}: {fattest}")]
 
 
 def _while_reachable(module: hlo_ir.Module) -> set:
@@ -430,6 +463,7 @@ RULES = {
     "host-sync": _rule_host_sync,
     "baked-constants": _rule_baked_constants,
     "ingest-edge": _rule_ingest_edge,
+    "peak-memory": _rule_peak_memory,
 }
 
 
@@ -444,6 +478,9 @@ def audit_program(hlo_text: str, contract: ProgramContract, jaxpr=None,
         "result_bytes": stats.collective_bytes(module),
         "chain_depth": stats.collective_chain_depth(module),
         "donated": module.donated_param_count(),
+        "peak_mib": round(
+            memlife.mem_report(module, contract.name).peak_bytes / 2**20,
+            3),
     }
     for rule, fn in RULES.items():
         findings = fn(module, jaxpr, contract)
@@ -620,6 +657,7 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
               max_constant_bytes: int = DEFAULT_MAX_CONSTANT_BYTES,
               metrics_ring: bool = True,
               collect_hlo: bool = False,
+              hbm_budget_bytes: int = 0,
               ) -> AuditResult:
     """Lower and audit the shipped program zoo: the 3 train paths for
     each strategy, the eval window, and (when ``serve_buckets`` is
@@ -700,7 +738,8 @@ def audit_zoo(*, model: str = "vgg11", global_batch: int = 256,
             nbuckets=nbuckets, param_bytes=param_bytes,
             n_state_leaves=n_state, donates_state=donates,
             precision=precision, max_constant_bytes=max_constant_bytes,
-            compress_ratio=ratio, aux_bytes=aux_bytes)
+            compress_ratio=ratio, aux_bytes=aux_bytes,
+            hbm_budget_bytes=hbm_budget_bytes)
 
     for strategy in strategies:
         mesh = single_mesh if strategy == "single" else full_mesh
@@ -865,7 +904,9 @@ def zoo_attribution(result: AuditResult) -> Dict:
                          "audit_zoo(..., collect_hlo=True)")
     reports = {name: costmodel.cost_report(text, name)
                for name, text in result.hlo.items()}
-    programs = {name: attrlib.attribute(rep)
+    programs = {name: attrlib.attribute(
+                    rep, mem_report=memlife.mem_report(result.hlo[name],
+                                                       name))
                 for name, rep in reports.items()}
     out: Dict = {"programs": programs}
     ov, dd = (reports.get("train/window/overlap"),

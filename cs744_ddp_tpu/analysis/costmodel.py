@@ -40,9 +40,13 @@ from typing import Dict, List, Optional, Tuple
 from . import hlo_ir, stats
 
 # v5e datasheet numbers shared by every MFU/roofline consumer in the repo.
+# analysis/memlife (the peak-HBM certifier) and analysis/megaplan (the
+# K-epoch planner) read the capacity from HERE — tools/lint_graft.py's
+# path-less run fails if any of these literals grows a second copy.
 V5E_BF16_PEAK_FLOPS = 197e12     # bf16 peak, per chip
 V5E_HBM_BYTES_PER_S = 819e9     # HBM bandwidth, per chip
 V5E_ICI_BYTES_PER_S = 200e9     # 1600 Gbit/s ICI, per chip per direction
+V5E_HBM_CAPACITY_BYTES = 16 * 2**30   # HBM capacity, per chip
 
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 _INT_DTYPES = ("pred", "s8", "u8", "s16", "u16", "s32", "u32", "s64", "u64")

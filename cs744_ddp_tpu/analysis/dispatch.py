@@ -98,6 +98,19 @@ def epoch_round_trip_bound(path: str, nbatches: int, window: int = 0, *,
     return trips + (1 if tail_batch else 0) + (1 if include_eval else 0)
 
 
+def mega_round_trip_bound(k_epochs: int, *, include_eval: bool = True) -> int:
+    """Closed-form host round-trips for a K-epoch MEGA-program (ROADMAP
+    item 3): the whole run is ONE dispatch whose ring drain is the single
+    fetch, plus the final eval fetch when the run evals on device.  The
+    windowed baseline pays ``k_epochs x epoch_round_trip_bound(...)``;
+    this is the O(1) the mega-program buys, and
+    :func:`megaplan.plan_k_epochs` certifies how large K can grow before
+    HBM takes it back."""
+    if k_epochs <= 0:
+        return 0
+    return 1 + (1 if include_eval else 0)
+
+
 @dataclass
 class ProgramCert:
     """Static dispatch facts for one lowered program."""

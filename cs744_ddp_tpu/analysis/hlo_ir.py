@@ -37,6 +37,14 @@ CALLED_ATTRS = ("to_apply", "body", "condition", "calls",
                 "branch_computations", "called_computations",
                 "computations")
 
+# Canonical element widths for HLO dtypes.  analysis/stats.py aliases
+# this table — one copy, so the byte accounting of the collective audit
+# and the liveness certifier (analysis/memlife) can never disagree.
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
 _IDENT_RE = re.compile(r"[%A-Za-z_][\w.\-]*")
 _NAME_AT_END_RE = re.compile(r"(%?[\w.\-]+)\s*$")
 _OPCODE_RE = re.compile(r"[a-z][\w\-]*")
@@ -376,3 +384,41 @@ def parse(hlo_text: str) -> Module:
             sigil=raw_name.startswith("%"), line_no=line_no)
         cur.instructions[ins.name] = ins
     return mod
+
+
+# ---------------------------------------------------------------------------
+# Concrete byte sizes (structural, tuple-recursive, layout-tolerant)
+# ---------------------------------------------------------------------------
+
+_TYPE_COMMENT_RE = re.compile(r"/\*.*?\*/")
+_ARRAY_TYPE_RE = re.compile(r"^\s*(\w+)\[([\d,\s]*)\]")
+
+
+def type_bytes(type_str: Optional[str]) -> int:
+    """Concrete byte size of an HLO type string, STRUCTURALLY: a bare
+    array shape (layout/tiling braces like ``{1,0:T(8,128)S(1)}``
+    tolerated and ignored) or a parenthesized tuple, recursed with the
+    same bracket-aware splitter the parser uses — so nested tuples and
+    ``/*index=N*/`` element comments (the optimized print) are handled
+    by structure, not by regex luck.  ``token[]``/``opaque[]`` and
+    dynamic shapes size to 0."""
+    s = _TYPE_COMMENT_RE.sub("", type_str or "").strip()
+    if not s:
+        return 0
+    if s.startswith("("):
+        inner = s[1:_scan_balanced(s, 0) - 1]
+        return sum(type_bytes(part) for part in split_top(inner))
+    m = _ARRAY_TYPE_RE.match(s)
+    if not m or m.group(1) not in DTYPE_BYTES:
+        return 0
+    n = DTYPE_BYTES[m.group(1)]
+    for d in m.group(2).split(","):
+        d = d.strip()
+        if d:
+            n *= int(d)
+    return n
+
+
+def result_bytes(ins: Instruction) -> int:
+    """Bytes of ``ins``'s result buffer(s) — tuple elements summed."""
+    return type_bytes(ins.result_type)

@@ -1,0 +1,276 @@
+"""Closed-form feasibility planner for the K-epoch mega-program.
+
+ROADMAP item 3 wants to fuse K whole epochs — train windows, on-device
+eval, the metrics ring — into ONE dispatched program, collapsing the
+per-epoch host round-trips (:func:`dispatch.epoch_round_trip_bound`)
+to the O(1) of :func:`dispatch.mega_round_trip_bound`.  The blocking
+question is sizing: every staged epoch parks its shuffled u8 batches
+and its ring rows in HBM for the whole dispatch, so K is bounded by
+the chip's 16 GiB.  This module answers ``max_feasible_K`` in closed
+form, composing three certified inputs:
+
+- the per-window static memory certificate (:func:`memlife.mem_report`
+  over the lowered train window — state bytes and the transient peak
+  the window's compute needs on top of them);
+- the ring carry growth model (one ``(N_METRICS,)`` f32 row per step,
+  :mod:`obs.ringbuf` — a K-epoch ring must hold every row until the
+  single drain, so it grows 16 B per step instead of wrapping at
+  ``DEFAULT_CAPACITY``);
+- the staging slab: epochs are dispatched at WINDOW granularity, so a
+  K-epoch program stages ``ceil(nbatches/window) * window`` per-chip
+  batches per epoch (window padding included — a bigger window pads
+  more and can only shrink K).
+
+All byte models are per CHIP: the slab and labels are data-sharded
+(``global_batch / world`` rows per chip), the state and ring are
+replicated.  The HBM budget defaults to the single-sourced
+:data:`costmodel.V5E_HBM_CAPACITY_BYTES`.
+
+``plan_k_epochs`` is pure arithmetic (jax-free, unit-pinned against
+hand-computed ring + state bytes in tests/test_memlife.py);
+``max_feasible_K`` lowers the real train window via the audit
+machinery to obtain the state/transient bytes, then delegates.  The
+result is the go/no-go artifact the mega-program PR builds against:
+its entry criterion is ``max_feasible_K(...) >= K`` for the K it
+proposes to fuse.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from . import costmodel, dispatch, memlife
+from ..obs import ringbuf
+
+#: CIFAR-10 sample footprint on the wire/stage path: u8 HWC image + i32
+#: label.  The serving/train ingest contract keeps images uint8 end to
+#: end, so the staged slab is 1 byte/px.
+IMG_BYTES = 32 * 32 * 3
+LABEL_BYTES = 4
+
+#: One ring row per scanned step: N_METRICS f32 columns.
+RING_ROW_BYTES = 4 * ringbuf.N_METRICS
+#: The i32 write counter carried beside the ring rows.
+RING_COUNTER_BYTES = 4
+
+#: CIFAR-10 train-split size, the default epoch length numerator.
+TRAIN_EXAMPLES = 50_000
+
+
+@dataclass
+class KEpochPlan:
+    """Feasibility certificate for fusing K epochs into one dispatch."""
+
+    model: str
+    world: int
+    window: int
+    global_batch: int
+    nbatches: int                    # full batches per epoch
+    hbm_budget_bytes: int
+    state_bytes: int                 # donated train state, replicated
+    transient_bytes: int             # window-program compute peak
+    fixed_bytes: int                 # K-independent residency
+    slab_bytes_per_epoch: int        # staged u8 images + labels, per chip
+    ring_bytes_per_epoch: int        # metric rows appended per epoch
+    per_epoch_bytes: int
+    max_k: int
+    windowed_round_trips_per_epoch: int
+    mega_round_trips: int            # for max_k epochs fused into one
+    notes: List[str] = field(default_factory=list)
+
+    @property
+    def round_trips_saved(self) -> int:
+        """Host round-trips the max-K mega-program erases vs dispatching
+        the same epochs down the windowed path."""
+        if self.max_k <= 0:
+            return 0
+        return (self.max_k * self.windowed_round_trips_per_epoch
+                - self.mega_round_trips)
+
+    def to_dict(self) -> Dict:
+        return {
+            "model": self.model,
+            "world": self.world,
+            "window": self.window,
+            "global_batch": self.global_batch,
+            "nbatches": self.nbatches,
+            "hbm_budget_mib": round(self.hbm_budget_bytes / 2**20, 1),
+            "state_mib": round(self.state_bytes / 2**20, 3),
+            "transient_mib": round(self.transient_bytes / 2**20, 3),
+            "fixed_mib": round(self.fixed_bytes / 2**20, 3),
+            "slab_mib_per_epoch": round(
+                self.slab_bytes_per_epoch / 2**20, 3),
+            "ring_kib_per_epoch": round(
+                self.ring_bytes_per_epoch / 2**10, 3),
+            "max_k": self.max_k,
+            "windowed_round_trips_per_epoch":
+                self.windowed_round_trips_per_epoch,
+            "mega_round_trips": self.mega_round_trips,
+            "round_trips_saved": self.round_trips_saved,
+            "notes": list(self.notes),
+        }
+
+
+def ring_bytes_for_steps(steps: int) -> int:
+    """Ring rows for ``steps`` scanned steps with no wraparound — the
+    K-epoch ring must keep every row until its single drain."""
+    return steps * RING_ROW_BYTES
+
+
+def slab_bytes_per_epoch(nbatches: int, window: int, global_batch: int,
+                         world: int) -> int:
+    """Per-chip staged bytes for one epoch: windows are cut at WINDOW
+    boundaries, so the stage pads to ``ceil(nbatches/window) * window``
+    batches of data-sharded u8 images + i32 labels."""
+    if nbatches <= 0 or window <= 0 or world <= 0:
+        raise ValueError(f"bad slab query: nbatches={nbatches} "
+                         f"window={window} world={world}")
+    padded_steps = math.ceil(nbatches / window) * window
+    per_chip_batch = max(1, global_batch // world)
+    return padded_steps * per_chip_batch * (IMG_BYTES + LABEL_BYTES)
+
+
+def plan_k_epochs(*, model: str = "vgg11", world: int = 8, window: int = 4,
+                  global_batch: int = 256, nbatches: Optional[int] = None,
+                  state_bytes: int, transient_bytes: int = 0,
+                  hbm_budget_bytes: Optional[int] = None) -> KEpochPlan:
+    """The closed form.  K-independent residency = state + the window
+    transient peak + the ring counter; each staged epoch adds its slab
+    and its ring rows.  ``max_k`` is the largest K whose total fits the
+    budget (0 when even the fixed residency does not fit)."""
+    budget = (costmodel.V5E_HBM_CAPACITY_BYTES
+              if hbm_budget_bytes is None else hbm_budget_bytes)
+    if nbatches is None:
+        nbatches = max(1, TRAIN_EXAMPLES // global_batch)
+    fixed = state_bytes + transient_bytes + RING_COUNTER_BYTES
+    slab = slab_bytes_per_epoch(nbatches, window, global_batch, world)
+    ring = ring_bytes_for_steps(nbatches)
+    per_epoch = slab + ring
+    max_k = max(0, (budget - fixed) // per_epoch) if per_epoch else 0
+    plan = KEpochPlan(
+        model=model, world=world, window=window,
+        global_batch=global_batch, nbatches=nbatches,
+        hbm_budget_bytes=budget, state_bytes=state_bytes,
+        transient_bytes=transient_bytes, fixed_bytes=fixed,
+        slab_bytes_per_epoch=slab, ring_bytes_per_epoch=ring,
+        per_epoch_bytes=per_epoch, max_k=int(max_k),
+        windowed_round_trips_per_epoch=dispatch.epoch_round_trip_bound(
+            "window", nbatches, window, include_eval=True),
+        mega_round_trips=dispatch.mega_round_trip_bound(
+            int(max_k), include_eval=True))
+    if max_k <= 0:
+        plan.notes.append(
+            f"infeasible: fixed residency {fixed} B + one epoch "
+            f"{per_epoch} B exceed the {budget} B budget")
+    return plan
+
+
+def lower_window(model: str = "vgg11", *, world: int = 8,
+                 window: int = 4, global_batch: int = 256,
+                 strategy: str = "ddp", metrics_ring: bool = True):
+    """Lower THE train window (the same recipe the audit zoo uses);
+    returns ``(lowered, name)`` so callers can take the HLO text for the
+    static certifier AND ``.compile()`` it for the differential check.
+    Requires jax; lowering is abstract (eval_shape), no parameters
+    materialize."""
+    import jax
+
+    from . import audit
+    from ..models import get_model
+    from ..ops import sgd
+    from ..parallel import get_strategy, mesh as meshlib
+    from ..train import step as steplib
+
+    mesh = meshlib.make_mesh(world)
+    w = mesh.devices.size
+    b = max(w, (global_batch // w) * w)
+    strat = get_strategy(strategy if w > 1 else "single")
+    init_fn, apply_fn = get_model(model)
+    st_sds = jax.eval_shape(
+        lambda k: steplib.init_train_state(init_fn, k, strat, w),
+        jax.random.PRNGKey(0))
+    ring_cap = ringbuf.DEFAULT_CAPACITY if metrics_ring else 0
+    sds = audit._train_sds(mesh, st_sds, b, window, ring_capacity=ring_cap)
+    fn = steplib.make_train_window(
+        apply_fn, strat, mesh, sgd.SGDConfig(), augment=True,
+        metrics_ring=metrics_ring)
+    head = (sds["state"], sds["ring"]) if metrics_ring else (sds["state"],)
+    args = head + (sds["key"], sds["epoch_images"], sds["epoch_labels"],
+                   sds["start"], sds["lengths"])
+    return fn.lower(*args), f"train/window/{strategy}@w{w}/{model}"
+
+
+def window_mem_report(model: str = "vgg11", *, world: int = 8,
+                      window: int = 4, global_batch: int = 256,
+                      strategy: str = "ddp",
+                      metrics_ring: bool = True) -> memlife.MemReport:
+    """Lower the train window and run the liveness certifier over it —
+    the per-window MemReport the planner composes."""
+    from . import audit
+
+    lowered, name = lower_window(
+        model, world=world, window=window, global_batch=global_batch,
+        strategy=strategy, metrics_ring=metrics_ring)
+    return memlife.mem_report(audit._hlo_text(lowered), name)
+
+
+def state_bytes_for(model: str, *, world: int = 8,
+                    strategy: str = "ddp") -> int:
+    """Donated train-state bytes (params + momentum + BN + step), from
+    ``jax.eval_shape`` — the replicated, K-independent carry."""
+    import jax
+
+    from ..models import get_model
+    from ..parallel import get_strategy
+    from ..train import step as steplib
+
+    strat = get_strategy(strategy if world > 1 else "single")
+    init_fn, _ = get_model(model)
+    st_sds = jax.eval_shape(
+        lambda k: steplib.init_train_state(init_fn, k, strat, world),
+        jax.random.PRNGKey(0))
+    return sum(l.size * l.dtype.itemsize
+               for l in jax.tree_util.tree_leaves(st_sds))
+
+
+def max_feasible_K(model: str = "vgg11", world: int = 8, window: int = 4,
+                   hbm_budget_bytes: Optional[int] = None, *,
+                   global_batch: int = 256, nbatches: Optional[int] = None,
+                   strategy: str = "ddp",
+                   window_report: Optional[memlife.MemReport] = None,
+                   ) -> int:
+    """The go/no-go number: the largest K epochs of ``model`` at
+    ``world`` chips and ``window``-step windows that fit one chip's HBM
+    budget.  Lowering the window (for the transient peak) is skipped
+    when the caller supplies ``window_report``."""
+    plan = plan_feasibility(
+        model, world, window, hbm_budget_bytes,
+        global_batch=global_batch, nbatches=nbatches, strategy=strategy,
+        window_report=window_report)
+    return plan.max_k
+
+
+def plan_feasibility(model: str = "vgg11", world: int = 8, window: int = 4,
+                     hbm_budget_bytes: Optional[int] = None, *,
+                     global_batch: int = 256,
+                     nbatches: Optional[int] = None, strategy: str = "ddp",
+                     window_report: Optional[memlife.MemReport] = None,
+                     ) -> KEpochPlan:
+    """Full :class:`KEpochPlan` behind :func:`max_feasible_K`."""
+    if window_report is None:
+        window_report = window_mem_report(
+            model, world=world, window=window, global_batch=global_batch,
+            strategy=strategy)
+    plan = plan_k_epochs(
+        model=model, world=world, window=window, global_batch=global_batch,
+        nbatches=nbatches,
+        state_bytes=state_bytes_for(model, world=world, strategy=strategy),
+        transient_bytes=window_report.transient_peak_bytes,
+        hbm_budget_bytes=hbm_budget_bytes)
+    plan.notes.append(
+        f"transient peak from {window_report.name}: "
+        f"{window_report.transient_peak_bytes} B (static, pre-SPMD "
+        f"global shapes — conservative per chip)")
+    return plan

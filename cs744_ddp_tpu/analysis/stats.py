@@ -29,10 +29,8 @@ from typing import Dict, Union
 
 from . import hlo_ir
 
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
-}
+# One dtype-width table for the whole analysis stack (hlo_ir owns it).
+_DTYPE_BYTES = hlo_ir.DTYPE_BYTES
 
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 

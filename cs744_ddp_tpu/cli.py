@@ -310,9 +310,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "and exit: repo lints, the lock-order deadlock "
                          "detector (certified acquisition order), wire-"
                          "protocol schema conformance against serve/"
-                         "wire.py, the full program-zoo audit, and the "
-                         "static host-round-trip certificate; prints a "
-                         "JSON summary, exits 2 on any finding")
+                         "wire.py, the full program-zoo audit (incl. the "
+                         "peak-HBM liveness certificate vs the v5e "
+                         "budget), and the static host-round-trip "
+                         "certificate; prints a JSON summary, exits 2 "
+                         "on any finding")
     return p
 
 
@@ -383,6 +385,7 @@ def verify_static_main(args, telemetry) -> None:
     import os
 
     from .analysis import audit as auditlib
+    from .analysis import costmodel, memlife
     from .analysis import dispatch as dispatchlib
     from .analysis import lockgraph, wire_schema
     from .analysis.pylint_rules import DEFAULT_TARGETS, lint_paths
@@ -404,10 +407,14 @@ def verify_static_main(args, telemetry) -> None:
     cert = dispatchlib.certify_zoo(result, window=4,
                                    nbatches=WINDOW + WINDOW // 4,
                                    include_eval=True)
+    findings += memlife.check_memory(repo)
     for f in findings:
         print(f"[verify-static] {f.rule}: {f.path}:{f.line} {f.message}")
     for line in result.format_lines():
         print(line)
+    peaks = {r.program: r.stats.get("peak_mib", 0.0)
+             for r in result.reports}
+    fattest = max(peaks, key=peaks.get) if peaks else None
     summary = {
         "clean": (not findings and result.clean and cert["clean"]),
         "lint_findings": len(findings),
@@ -416,6 +423,15 @@ def verify_static_main(args, telemetry) -> None:
         "audit": {"clean": result.clean, "n_programs": len(result.reports),
                   "n_findings": len(result.findings())},
         "dispatch": cert,
+        # Compact memory certificate: the zoo-wide peak vs the
+        # single-sourced per-chip budget (the peak-memory audit rule is
+        # what fails "clean"; this entry is the headline number).
+        "memory": {
+            "budget_mib": round(
+                costmodel.V5E_HBM_CAPACITY_BYTES / 2**20, 1),
+            "max_peak_mib": max(peaks.values(), default=0.0),
+            "max_peak_program": fattest,
+        },
     }
     print(json.dumps(summary))
     auditlib.record_audit(telemetry, result)

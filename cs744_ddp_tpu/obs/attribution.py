@@ -14,6 +14,11 @@ bytes); a measured per-dispatch time says what it DID.  The join yields:
   depth of 1, at most the LARGEST collective is exposed; ``ddp``'s
   barrier-chained plan pays the full sum (round-7 ladder, measured here
   against the same ICI model).
+- **HBM residency** (round 20) — when the caller supplies the program's
+  static liveness certificate (:func:`analysis.memlife.mem_report`),
+  the record also carries the certified peak and its headroom against
+  the chip capacity, so one attribution row answers both "how fast" and
+  "does it fit".
 """
 
 from __future__ import annotations
@@ -21,19 +26,24 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from ..analysis.costmodel import (CostReport, V5E_BF16_PEAK_FLOPS,
-                                  V5E_HBM_BYTES_PER_S, V5E_ICI_BYTES_PER_S,
-                                  mfu_fields)
+                                  V5E_HBM_BYTES_PER_S,
+                                  V5E_HBM_CAPACITY_BYTES,
+                                  V5E_ICI_BYTES_PER_S, mfu_fields)
 
 __all__ = ["attribute", "overlap_vs_ddp", "mfu_fields"]
 
 
 def attribute(report: CostReport, *, measured_s: Optional[float] = None,
+              mem_report=None,
               peak_flops: float = V5E_BF16_PEAK_FLOPS,
               hbm_bytes_per_s: float = V5E_HBM_BYTES_PER_S,
+              hbm_capacity_bytes: int = V5E_HBM_CAPACITY_BYTES,
               ici_bytes_per_s: float = V5E_ICI_BYTES_PER_S) -> Dict:
     """Attribution record for one program; ``measured_s`` (per-dispatch
     seconds, same per-device scope as the report) adds the measured-join
-    fields, otherwise the record is purely analytic."""
+    fields, otherwise the record is purely analytic.  ``mem_report``
+    (an :class:`analysis.memlife.MemReport` for the SAME program) adds
+    the certified peak-residency fields."""
     compute_s = report.flops / peak_flops
     hbm_s = report.hbm_bytes / hbm_bytes_per_s
     comm_s = report.wire_bytes / ici_bytes_per_s
@@ -60,6 +70,13 @@ def attribute(report: CostReport, *, measured_s: Optional[float] = None,
         out["measured_s"] = round(measured_s, 6)
         out["achieved_tflops_per_sec"] = round(achieved / 1e12, 4)
         out["mfu_vs_bf16_peak"] = round(achieved / peak_flops, 6)
+    if mem_report is not None:
+        peak = int(mem_report.peak_bytes)
+        out["peak_hbm_mib"] = round(peak / 2**20, 3)
+        out["hbm_headroom_mib"] = round(
+            (hbm_capacity_bytes - peak) / 2**20, 3)
+        out["hbm_capacity_utilization"] = round(
+            peak / hbm_capacity_bytes, 6) if hbm_capacity_bytes else None
     return out
 
 

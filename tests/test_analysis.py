@@ -45,8 +45,8 @@ import jax.numpy as jnp
 from cs744_ddp_tpu import models as model_zoo
 from cs744_ddp_tpu.analysis import audit as auditlib
 from cs744_ddp_tpu.analysis import dispatch as dispatchlib
-from cs744_ddp_tpu.analysis import (hlo_ir, lockgraph, pylint_rules, stats,
-                                    wire_schema)
+from cs744_ddp_tpu.analysis import (hlo_ir, lockgraph, memlife,
+                                    pylint_rules, stats, wire_schema)
 from cs744_ddp_tpu.obs import Telemetry
 from cs744_ddp_tpu.serve import wire
 from cs744_ddp_tpu.train.loop import Trainer
@@ -355,10 +355,14 @@ def test_rule_dtype_leak():
 
 
 def test_rule_donation():
+    # Both donated params need a same-size output leaf to alias (round 20:
+    # donation is checked as aliased-bytes equality, not just leaf count).
     donated = ("HloModule m, buffer_donor={ (0, {}), (1, {}) }\n\n"
                "ENTRY main {\n  p0 = f32[4] parameter(0)\n"
                "  p1 = f32[4] parameter(1)\n"
-               "  ROOT s = f32[4] add(p0, p1)\n}\n")
+               "  s = f32[4] add(p0, p1)\n"
+               "  d = f32[4] multiply(p0, p1)\n"
+               "  ROOT t = (f32[4], f32[4]) tuple(s, d)\n}\n")
     undonated = ("HloModule m\n\nENTRY main {\n"
                  "  p0 = f32[4] parameter(0)\n"
                  "  p1 = f32[4] parameter(1)\n"
@@ -1457,13 +1461,15 @@ def test_static_round_trip_bound_matches_runtime_exactly(tmp_path, mesh4):
 # ---------------------------------------------------------------------------
 
 def test_repo_static_verification(zoo):
-    """Folds --audit-zoo, the repo lints, and the three whole-program
-    analyzers into one gate — what ``--verify-static`` runs from the
+    """Folds --audit-zoo, the repo lints, and the whole-program
+    analyzers (lock order, wire schema, memory single-source + fixture
+    invariants) into one gate — what ``--verify-static`` runs from the
     CLI, asserted here as a tier-1 test."""
     findings = pylint_rules.lint_paths(
         [os.path.join(REPO, t) for t in pylint_rules.DEFAULT_TARGETS])
     findings += lockgraph.check_locks(REPO)
     findings += wire_schema.check_wire(REPO)
+    findings += memlife.check_memory(REPO)
     assert findings == [], _fmt(findings)
     assert zoo.clean, "\n".join(zoo.format_lines())
     cert = dispatchlib.certify_zoo(zoo, window=3, nbatches=25)

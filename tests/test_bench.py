@@ -424,6 +424,21 @@ def test_emit_head_budget_with_committed_serving_load(tmp_path):
     assert wf["max_inflight"] <= 2
     b8 = wf["cost_prior"]["by_bucket"]["8"]["measured_over_prior"]
     assert b8 < 3.254          # the round-12 dispatch-tax figure
+    # Round 20: the memory section honors ITS contracts — every zoo
+    # program certified under the v5e budget, the compiled differential
+    # clean (static >= XLA's temp+output floor, within band), and the
+    # K-epoch planner table concrete and rising with the mesh.
+    mem = result["memory"]
+    assert mem["max_peak"]["peak_mib"] <= mem["budget_mib"]
+    assert all(v <= mem["budget_mib"]
+               for v in mem["peak_mib_by_program"].values())
+    assert mem["compiled_check"]["clean"] is True
+    assert mem["compiled_check"]["static_peak_mib"] \
+        >= mem["compiled_check"]["compiled_floor_mib"]
+    per_world = mem["planner"]["per_world"]
+    ks = [per_world[w]["max_k"] for w in ("1", "2", "8")]
+    assert ks == sorted(ks) and ks[0] > 0
+    assert all(per_world[w]["mega_round_trips"] == 2 for w in per_world)
     lines = []
     head = bench.emit_result(result, str(tmp_path / "FULL.json"),
                              out=lines.append)
@@ -435,6 +450,7 @@ def test_emit_head_budget_with_committed_serving_load(tmp_path):
     assert "hotswap" not in parsed
     assert "tracing" not in parsed
     assert "pipeline" not in parsed
+    assert "memory" not in parsed
     assert json.loads((tmp_path / "FULL.json").read_text()) == result
 
 

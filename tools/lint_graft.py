@@ -5,15 +5,18 @@ Enforces the repo's concurrency/measurement invariants statically:
 un-fenced timing around device dispatches, jnp on producer/batcher
 threads, shared-state writes outside the owning lock, and
 distributed-trace spans emitted without their join keys
-(span-hygiene).  A default (path-less) run also certifies the two
+(span-hygiene).  A default (path-less) run also certifies the
 whole-program analyzers: the lock-order deadlock detector
 (analysis/lockgraph — acyclic acquisition graph on the declared
-partial order, *_locked caller-holds verified) and wire-protocol
+partial order, *_locked caller-holds verified), wire-protocol
 schema conformance (analysis/wire_schema — every struct format/TLV
 tag against serve/wire.py, encoder/decoder symmetry, total
-extension parsing).  Exits nonzero on any finding, so it slots into
-CI as-is; tests/test_analysis.py runs the same checks as a tier-1
-test.
+extension parsing), and the memory self-checks (analysis/memlife —
+the v5e roofline/capacity literals stay single-sourced in
+analysis/costmodel.py, and the committed fixture pair keeps proving
+the donation delta in bytes).  Exits nonzero on any finding, so it
+slots into CI as-is; tests/test_analysis.py runs the same checks as
+a tier-1 test.
 
     python tools/lint_graft.py              # lint + lockgraph + wire
     python tools/lint_graft.py serve ft     # lint specific paths only
@@ -74,11 +77,12 @@ def main(argv=None) -> int:
         paths = args.paths
         findings = lint_paths(paths)
     else:
-        from cs744_ddp_tpu.analysis import lockgraph, wire_schema
+        from cs744_ddp_tpu.analysis import lockgraph, memlife, wire_schema
         findings = lint_paths([os.path.join(_REPO_ROOT, t)
                                for t in DEFAULT_TARGETS])
         findings += lockgraph.check_locks(_REPO_ROOT)
         findings += wire_schema.check_wire(_REPO_ROOT)
+        findings += memlife.check_memory(_REPO_ROOT)
     if args.dispatch:
         findings += _dispatch_findings()
 

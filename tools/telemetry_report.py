@@ -196,6 +196,62 @@ def _attribution_lines(manifest) -> list:
     return lines
 
 
+def _memory_lines(events, manifest) -> list:
+    """Memory rendering (round 20): the runtime ``memory`` gauges that
+    ``train/loop.emit_memory_gauges`` records at window/epoch boundaries
+    (peak host RSS, live device bytes via ``jax.live_arrays``) joined
+    against the static peak-HBM certificate the audit attaches per
+    program (``peak_mib`` from analysis/memlife.py).  A measured device
+    residency above the fattest certified peak means the liveness model
+    missed a buffer — the same inequality tier-1 pins.  Returns [] for
+    runs with neither signal — older runs render unchanged."""
+    rss, live_mib, live_n = [], [], []
+    for e in events:
+        if e.get("kind") != "gauge" or e.get("name") != "memory":
+            continue
+        v = e.get("value")
+        if not isinstance(v, dict):
+            continue
+        if "host_rss_peak_mib" in v:
+            rss.append(v["host_rss_peak_mib"])
+        if "device_live_mib" in v:
+            live_mib.append(v["device_live_mib"])
+        if "device_live_arrays" in v:
+            live_n.append(v["device_live_arrays"])
+    certified = {}
+    for prog, rec in (((manifest or {}).get("audit") or {})
+                      .get("programs") or {}).items():
+        if isinstance(rec, dict) and rec.get("peak_mib") is not None:
+            certified[prog] = rec["peak_mib"]
+    if not rss and not live_mib and not certified:
+        return []
+    lines = ["== memory (measured vs certified) =="]
+    if live_mib:
+        lines.append(f"  device live (gauge)    x{len(live_mib):<6} "
+                     f"max {max(live_mib):10.2f} MiB  "
+                     f"last {live_mib[-1]:10.2f} MiB"
+                     + (f"  ({live_n[-1]} arrays)" if live_n else ""))
+    if rss:
+        lines.append(f"  host RSS peak          x{len(rss):<6} "
+                     f"max {max(rss):10.1f} MiB")
+    if certified:
+        fattest = max(certified, key=certified.get)
+        lines.append(f"  certified peak (max)   {certified[fattest]:10.3f} "
+                     f"MiB  ({fattest}, static liveness bound)")
+        if live_mib:
+            if max(live_mib) <= certified[fattest]:
+                lines.append(f"  verdict                measured within "
+                             f"certificate (headroom "
+                             f"{certified[fattest] - max(live_mib):.2f} MiB)")
+            else:
+                lines.append(f"  !! measured device residency "
+                             f"{max(live_mib):.2f} MiB EXCEEDS the "
+                             f"certified peak — liveness model missed "
+                             f"a buffer")
+    lines.append("")
+    return lines
+
+
 def _trace_lines(events) -> list:
     """Serving-causality rendering (round 8): per-request trace ids ride
     the enqueue -> batch -> dispatch -> fetch spans, and two per-request
@@ -493,6 +549,7 @@ def render(out_dir: str) -> str:
     lines.extend(_elastic_lines(events, manifest))
     lines.extend(_audit_lines(manifest))
     lines.extend(_attribution_lines(manifest))
+    lines.extend(_memory_lines(events, manifest))
     lines.extend(_trace_lines(events))
     lines.extend(_slo_lines(events))
     lines.extend(_publish_lines(events))
